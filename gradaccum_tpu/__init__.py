@@ -1,0 +1,29 @@
+"""gradaccum_tpu — a TPU-native training framework (JAX / XLA / pjit / pallas).
+
+Re-implements, TPU-first, the full capability surface of
+hpandana/gradient-accumulation-tf-estimator: gradient accumulation as a
+first-class training transform (single-XLA-graph `lax.scan` over micro-batches,
+plus a streaming `step % K` mode matching the reference's tf.cond semantics),
+AdamW with linear-warmup/polynomial-decay and clip-after-average, data-parallel
+training over a `jax.sharding.Mesh` (psum over ICI instead of
+MultiWorkerMirroredStrategy's ring all-reduce), an Estimator-shaped
+train/eval/predict harness with checkpoint/resume and streaming metrics, and
+model/data/entrypoint parity for the MNIST, housing-regression and BERT
+experiments.
+
+See SURVEY.md at the repo root for the file:line map to the reference.
+"""
+
+from gradaccum_tpu import data, estimator, models, ops, parallel, utils
+from gradaccum_tpu.ops.accumulation import (
+    GradAccumConfig,
+    accumulate_scan,
+    scan_init,
+    stack_micro_batches,
+    streaming_init,
+    streaming_step,
+)
+from gradaccum_tpu.ops.adamw import adam, adamw
+from gradaccum_tpu.ops.schedule import warmup_polynomial_decay
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+from gradaccum_tpu.ops import accumulation, adamw, clipping, schedule
+from gradaccum_tpu.ops.accumulation import (
+    GradAccumConfig,
+    accumulate_scan,
+    scan_init,
+    stack_micro_batches,
+    streaming_init,
+    streaming_step,
+)
+from gradaccum_tpu.ops.adamw import Optimizer, adam, adamw, sgd
+from gradaccum_tpu.ops.clipping import clip_by_global_norm
+from gradaccum_tpu.ops.schedule import polynomial_decay, warmup_polynomial_decay

@@ -1,0 +1,253 @@
+"""Gradient accumulation as a first-class training transform.
+
+This is the TPU-native rebuild of the reference's core product: the
+tf.cond-gated accumulate/apply ``train_op`` (/root/reference/optimization.py:
+76-103 and its three inlined copies). Two modes:
+
+**Scan mode** (:func:`accumulate_scan`) — the *primary* TPU design. The
+reference streams micro-batches through separate ``session.run`` calls only
+because tf.estimator forces it to; on TPU we own the step function, so one
+jitted step takes a ``[K, micro_batch, ...]`` stacked super-batch and runs
+``jax.lax.scan`` over the K micro-batches, accumulating gradients in the scan
+carry. One XLA graph: no accumulator variables live between host steps, no
+per-micro-batch host round-trip, and XLA overlaps the micro-batch pipeline.
+Semantics = reference steady state: mean over the K micro-batch gradients,
+optional global-norm clip *after* averaging (optimization.py:83-84), one
+optimizer apply.
+
+**Streaming mode** (:func:`streaming_step`) — capability/semantics parity with
+the reference: accumulators are persistent state, each call consumes ONE
+micro-batch, and ``lax.cond(step % K == 0)`` picks the accumulate or apply
+branch (optimization.py:91-94). Preserved fine print (SURVEY.md §0):
+
+- ``step`` counts micro-batches, not updates, and is bumped unconditionally
+  after the cond (optimization.py:102-103) — LR schedules see micro-batches.
+- The apply branch *re-accumulates the current gradient first*
+  (optimization.py:81), then normalizes by 1/K, optionally clips, applies,
+  and zeroes the accumulators (optimization.py:80-88).
+- The first-step quirk: with ``first_step_quirk=True`` (reference behavior),
+  step 0 takes the apply branch with a single accumulated micro-batch still
+  normalized by 1/K — a K×-under-scaled first update. ``False`` shifts the
+  apply phase to ``step % K == K-1`` so every update sees exactly K
+  micro-batches.
+
+**Data parallelism**: pass ``axis_name`` when the step runs under
+``shard_map``/``pmap`` over a mesh axis. Gradients are accumulated locally
+(one collective per K micro-batches, not per micro-batch) and ``pmean``-ed at
+apply time — the ICI equivalent of the reference's SUM-aggregated mirrored
+accumulators + 1/num_workers loss scaling (distributedExample/04:46,55).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gradaccum_tpu.ops.adamw import Optimizer
+from gradaccum_tpu.ops.clipping import clip_by_global_norm
+from gradaccum_tpu.utils.tree import global_norm, tree_zeros_like
+
+
+class GradAccumConfig(NamedTuple):
+    """Knobs shared by both modes.
+
+    ``num_micro_batches`` is the reference's ``gradient_accumulation_multiplier``
+    (optimization.py:76; hparam in the other flavors, e.g. another-example.py:276).
+    """
+
+    num_micro_batches: int
+    clip_norm: Optional[float] = None  # BERT flavor: 1.0; MNIST/housing: None
+    axis_name: Optional[str] = None  # data-parallel mesh axis, if any
+    first_step_quirk: bool = True  # streaming mode only
+
+
+# loss_fn(params, micro_batch) -> scalar loss (mean over the micro batch).
+LossFn = Callable[[Any, Any], jnp.ndarray]
+
+
+def _sync_grads(grads, axis_name):
+    if axis_name is None:
+        return grads
+    return lax.pmean(grads, axis_name)
+
+
+def _finalize(grads, config: GradAccumConfig):
+    """normalize-by-K → cross-replica mean → optional clip (optimization.py:83-84)."""
+    k = float(config.num_micro_batches)
+    grads = jax.tree.map(lambda g: g / k, grads)
+    grads = _sync_grads(grads, config.axis_name)
+    if config.clip_norm is not None:
+        grads, norm = clip_by_global_norm(grads, config.clip_norm)
+    else:
+        norm = global_norm(grads)
+    return grads, norm
+
+
+# --------------------------------------------------------------------------
+# Scan mode
+# --------------------------------------------------------------------------
+
+
+class ScanState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray  # micro-batches consumed so far (reference global_step)
+
+
+def scan_init(params, optimizer: Optimizer) -> ScanState:
+    return ScanState(
+        params=params,
+        opt_state=optimizer.init(params),
+        step=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def accumulate_scan(
+    loss_fn: LossFn,
+    optimizer: Optimizer,
+    config: GradAccumConfig,
+) -> Callable[[ScanState, Any], tuple]:
+    """Build the scan-mode train step.
+
+    The returned ``train_step(state, super_batch)`` expects every leaf of
+    ``super_batch`` stacked to ``[K, micro_batch, ...]`` and returns
+    ``(new_state, aux)`` with ``aux = {"loss": mean-over-K, "grad_norm": ...,
+    "lr_step": ...}``. ``state.step`` advances by K (micro-batch counting,
+    optimization.py:102-103), and the optimizer/schedule sees the counter at
+    the *end* of the cycle — the same step value at which the reference's
+    steady-state apply branch fires (it applies at ``global_step == m*K``,
+    the last micro-batch of cycle m; optimization.py:91).
+    """
+    k = config.num_micro_batches
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def train_step(state: ScanState, super_batch):
+        leading = {x.shape[0] for x in jax.tree.leaves(super_batch)}
+        if leading != {k}:
+            raise ValueError(
+                f"super_batch leaves must be stacked [K={k}, micro, ...]; got "
+                f"leading dims {sorted(leading)}. Use stack_micro_batches(batch, K)."
+            )
+
+        def body(accum, micro_batch):
+            loss, grads = grad_fn(state.params, micro_batch)
+            accum = jax.tree.map(jnp.add, accum, grads)
+            return accum, loss
+
+        accum0 = tree_zeros_like(state.params)
+        accum, losses = lax.scan(body, accum0, super_batch, length=k)
+        grads, norm = _finalize(accum, config)
+        apply_step = state.step + k
+        new_params, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params, apply_step
+        )
+        new_state = ScanState(
+            params=new_params, opt_state=new_opt_state, step=apply_step
+        )
+        loss = jnp.mean(losses)
+        if config.axis_name is not None:
+            loss = lax.pmean(loss, config.axis_name)
+        return new_state, {"loss": loss, "grad_norm": norm, "lr_step": apply_step}
+
+    return train_step
+
+
+def stack_micro_batches(batch, num_micro_batches: int):
+    """Reshape a ``[K*B, ...]`` host batch into the ``[K, B, ...]`` super-batch."""
+
+    def reshape(x):
+        return x.reshape((num_micro_batches, -1) + x.shape[1:])
+
+    return jax.tree.map(reshape, batch)
+
+
+# --------------------------------------------------------------------------
+# Streaming mode (reference tf.cond semantics)
+# --------------------------------------------------------------------------
+
+
+class StreamingState(NamedTuple):
+    params: Any
+    opt_state: Any
+    accum_grads: Any  # the reference's accum_grads variables (optimization.py:78)
+    step: jnp.ndarray  # micro-batch counter == reference global_step
+
+
+def streaming_init(params, optimizer: Optimizer) -> StreamingState:
+    return StreamingState(
+        params=params,
+        opt_state=optimizer.init(params),
+        accum_grads=tree_zeros_like(params),
+        step=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def streaming_step(
+    loss_fn: LossFn,
+    optimizer: Optimizer,
+    config: GradAccumConfig,
+) -> Callable[[StreamingState, Any], tuple]:
+    """Build the streaming-mode train step (one micro-batch per call).
+
+    Mirrors optimization.py:76-103 exactly; see module docstring for the
+    preserved fine print. ``aux["applied"]`` is 1.0 on apply steps.
+    """
+    k = config.num_micro_batches
+    grad_fn = jax.value_and_grad(loss_fn)
+    # Reference phase: apply when step % K == 0 (optimization.py:91) — includes
+    # the step-0 quirk. Quirk-free phase applies once K grads have accumulated.
+    phase = 0 if config.first_step_quirk else k - 1
+    # Schedule step at apply. Quirk mode: the reference evaluates the schedule
+    # at the pre-increment global_step (optimization.py:91 vs 102). Quirk-free
+    # mode: use the post-increment count (= micro-batches consumed, m*K) so a
+    # non-constant schedule sees exactly the same steps as scan mode's
+    # `state.step + K`.
+    step_offset = 0 if config.first_step_quirk else 1
+
+    def train_step(state: StreamingState, micro_batch):
+        loss, grads = grad_fn(state.params, micro_batch)
+
+        def apply_branch(operand):
+            params, opt_state, accum = operand
+            # (a) re-accumulate the current grad first (optimization.py:81)
+            accum = jax.tree.map(jnp.add, accum, grads)
+            # (b)-(c) normalize, cross-replica mean, clip (optimization.py:83-84)
+            avg, _ = _finalize(accum, config)
+            # (d) apply (optimization.py:85); schedule sees the micro-batch step
+            new_params, new_opt_state = optimizer.update(
+                avg, opt_state, params, state.step + step_offset
+            )
+            # (e) zero the accumulators (optimization.py:87)
+            return new_params, new_opt_state, tree_zeros_like(accum)
+
+        def accumulate_branch(operand):
+            params, opt_state, accum = operand
+            accum = jax.tree.map(jnp.add, accum, grads)
+            return params, opt_state, accum
+
+        applied = (state.step % k) == phase
+        new_params, new_opt_state, new_accum = lax.cond(
+            applied,
+            apply_branch,
+            accumulate_branch,
+            (state.params, state.opt_state, state.accum_grads),
+        )
+        # Unconditional micro-batch bump (optimization.py:102-103).
+        new_state = StreamingState(
+            params=new_params,
+            opt_state=new_opt_state,
+            accum_grads=new_accum,
+            step=state.step + 1,
+        )
+        # aux loss is replica-local on purpose: collectives fire once per K
+        # micro-batches (inside _finalize), never per micro-batch. Callers
+        # aggregate losses across replicas at logging time if they care.
+        return new_state, {
+            "loss": loss,
+            "applied": applied.astype(jnp.float32),
+        }
+
+    return train_step

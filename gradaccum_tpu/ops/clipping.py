@@ -1,0 +1,22 @@
+"""Gradient clipping.
+
+The reference clips by global norm (clip_norm=1.0) on the *averaged
+accumulated* gradient, immediately before ``apply_gradients``
+(/root/reference/optimization.py:83-85; README.md:21 removes the original
+per-micro-batch clip). Matches ``tf.clip_by_global_norm`` semantics: a single
+scale factor ``clip_norm / max(global_norm, clip_norm)`` applied to every leaf.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gradaccum_tpu.utils.tree import global_norm
+
+
+def clip_by_global_norm(grads, clip_norm: float):
+    """Returns ``(clipped_grads, global_norm)``."""
+    norm = global_norm(grads)
+    scale = clip_norm / jnp.maximum(norm, clip_norm)
+    return jax.tree.map(lambda g: g * scale.astype(g.dtype), grads), norm

@@ -1,0 +1,82 @@
+"""Learning-rate schedules.
+
+TPU-native rebuild of the schedule in the reference's ``create_optimizer``
+(/root/reference/optimization.py:29-54): polynomial decay to 0 over
+``num_train_steps`` (power 1.0 → linear), blended with a linear warmup via an
+``is_warmup`` mask. Schedules are pure functions of the step so they can be
+traced inside ``jax.jit``.
+
+Semantic fine print preserved (SURVEY.md §0): the reference keys this schedule
+off a ``global_step`` that counts **micro-batches, not optimizer updates**
+(optimization.py:102-103). The caller owns the step — pass whichever counter
+matches the mode (see ops/accumulation.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def constant(value: float) -> Schedule:
+    def schedule(step):
+        del step
+        return jnp.asarray(value, dtype=jnp.float32)
+
+    return schedule
+
+
+def polynomial_decay(
+    init_value: float,
+    decay_steps: int,
+    end_value: float = 0.0,
+    power: float = 1.0,
+) -> Schedule:
+    """``tf.train.polynomial_decay`` with ``cycle=False`` (optimization.py:32-38)."""
+
+    def schedule(step):
+        frac = jnp.minimum(step.astype(jnp.float32), float(decay_steps)) / float(
+            decay_steps
+        )
+        return (init_value - end_value) * (1.0 - frac) ** power + end_value
+
+    return schedule
+
+
+def warmup_polynomial_decay(
+    init_lr: float,
+    num_train_steps: int,
+    num_warmup_steps: int = 0,
+    end_value: float = 0.0,
+    power: float = 1.0,
+) -> Schedule:
+    """Linear warmup blended into polynomial decay (optimization.py:29-54).
+
+    For ``step < num_warmup_steps``: ``lr = init_lr * step / num_warmup_steps``
+    (optimization.py:47-50). At and after the boundary the decayed rate applies
+    (the reference's mask is ``global_step < warmup_steps``,
+    optimization.py:52). With ``num_warmup_steps=0`` this is pure decay.
+    """
+    decay = polynomial_decay(init_lr, num_train_steps, end_value, power)
+    if not num_warmup_steps:
+        return decay
+
+    def schedule(step):
+        step = jnp.asarray(step)
+        decayed = decay(step)
+        warmup_frac = step.astype(jnp.float32) / float(num_warmup_steps)
+        warmup_lr = init_lr * warmup_frac
+        is_warmup = (step < num_warmup_steps).astype(jnp.float32)
+        return (1.0 - is_warmup) * decayed + is_warmup * warmup_lr
+
+    return schedule
+
+
+def as_schedule(lr) -> Schedule:
+    """Lift a float (or schedule) into a :data:`Schedule`."""
+    if callable(lr):
+        return lr
+    return constant(float(lr))

@@ -1,0 +1,7 @@
+from gradaccum_tpu.utils.tree import (
+    global_norm,
+    named_leaves,
+    path_name,
+    tree_map_with_names,
+    tree_zeros_like,
+)

@@ -1,0 +1,65 @@
+"""Pytree utilities: stable parameter naming and tree math.
+
+The reference optimizer keys weight-decay exclusion off *variable names*
+(/root/reference/optimization.py:179-194, regex-searched against
+``["LayerNorm", "layer_norm", "bias"]`` with the ``:0`` suffix stripped).
+In a pytree world the equivalent stable name is the key path, joined with
+"/" — e.g. ``params/bert/encoder/layer_0/attention/output/LayerNorm/scale``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import tree_util
+
+
+def _key_entry_str(entry) -> str:
+    if isinstance(entry, tree_util.DictKey):
+        return str(entry.key)
+    if isinstance(entry, tree_util.SequenceKey):
+        return str(entry.idx)
+    if isinstance(entry, tree_util.GetAttrKey):
+        return str(entry.name)
+    if isinstance(entry, tree_util.FlattenedIndexKey):
+        return str(entry.key)
+    return str(entry)
+
+
+def path_name(path) -> str:
+    """Join a jax key path into a stable "/"-separated parameter name."""
+    return "/".join(_key_entry_str(e) for e in path)
+
+
+def named_leaves(tree):
+    """Return ``[(name, leaf), ...]`` with names from :func:`path_name`."""
+    flat, _ = tree_util.tree_flatten_with_path(tree)
+    return [(path_name(path), leaf) for path, leaf in flat]
+
+
+def tree_map_with_names(fn, tree, *rest):
+    """Like ``jax.tree.map`` but ``fn(name, leaf, *rest_leaves)``.
+
+    The name is the "/"-joined key path of the leaf — the rebuild's analogue
+    of the reference's ``param.name`` (optimization.py:189-194).
+    """
+
+    def _fn(path, leaf, *others):
+        return fn(path_name(path), leaf, *others)
+
+    return tree_util.tree_map_with_path(_fn, tree, *rest)
+
+
+def tree_zeros_like(tree):
+    """Zero-initialized tree — the accumulator allocation of optimization.py:78."""
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def global_norm(tree) -> jax.Array:
+    """L2 norm over all leaves, matching ``tf.linalg.global_norm``."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((), dtype=jnp.float32)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    )

@@ -1,0 +1,22 @@
+"""Test environment: an 8-device virtual CPU mesh standing in for a TPU slice.
+
+The reference has no fake backend (SURVEY.md §4); this is ours. Must run
+before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(19830610)  # the reference's seed (01:77 etc.)

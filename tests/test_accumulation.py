@@ -1,0 +1,201 @@
+"""Golden tests for the accumulation transforms (SURVEY.md §4 item (a), (f)).
+
+Core invariant: K accumulated micro-batch gradients at frozen params ==
+the gradient of one K×-bigger batch, so a scan-mode step must equal a
+big-batch step exactly (same optimizer, same params)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gradaccum_tpu.ops.accumulation import (
+    GradAccumConfig,
+    accumulate_scan,
+    scan_init,
+    stack_micro_batches,
+    streaming_init,
+    streaming_step,
+)
+from gradaccum_tpu.ops.adamw import adam, adamw, sgd
+
+K = 4
+B = 8
+
+
+def loss_fn(params, batch):
+    pred = batch["x"] @ params["w"] + params["bias"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def make_data(rng, n):
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    w_true = np.asarray([[1.0], [-2.0], [0.5]], np.float32)
+    y = x @ w_true + 0.1 * rng.normal(size=(n, 1)).astype(np.float32)
+    return {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+
+
+def make_params(rng):
+    return {
+        "w": jnp.asarray(rng.normal(size=(3, 1)), jnp.float32),
+        "bias": jnp.zeros((1,), jnp.float32),
+    }
+
+
+def test_scan_step_equals_big_batch_step(rng):
+    params = make_params(rng)
+    big = make_data(rng, K * B)
+    opt = sgd(0.05)
+
+    # One big-batch SGD step by hand.
+    g = jax.grad(loss_fn)(params, big)
+    expected = jax.tree.map(lambda p, gg: p - 0.05 * gg, params, g)
+
+    step_fn = jax.jit(
+        accumulate_scan(loss_fn, opt, GradAccumConfig(num_micro_batches=K))
+    )
+    state = scan_init(params, opt)
+    new_state, aux = step_fn(state, stack_micro_batches(big, K))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        new_state.params,
+        expected,
+    )
+    assert int(new_state.step) == K
+    assert np.isfinite(float(aux["loss"]))
+
+
+def test_scan_equals_big_batch_with_adamw(rng):
+    params = make_params(rng)
+    big = make_data(rng, K * B)
+    opt = adamw(1e-2, weight_decay_rate=0.01)
+
+    g = jax.grad(loss_fn)(params, big)
+    expected, _ = opt.update(g, opt.init(params), params, K)
+
+    step_fn = accumulate_scan(loss_fn, opt, GradAccumConfig(num_micro_batches=K))
+    new_state, _ = step_fn(scan_init(params, opt), stack_micro_batches(big, K))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        new_state.params,
+        expected,
+    )
+
+
+def test_streaming_quirk_free_equals_scan(rng):
+    """Quirk-free streaming over 2 cycles == 2 scan steps, including the LR
+    schedule trajectory (schedule is non-constant to catch step-counting
+    divergence between the modes)."""
+    from gradaccum_tpu.ops.schedule import warmup_polynomial_decay
+
+    params = make_params(rng)
+    sched = warmup_polynomial_decay(1e-2, num_train_steps=10 * K, num_warmup_steps=K)
+    opt = adamw(sched, weight_decay_rate=0.01)
+    cfg = GradAccumConfig(num_micro_batches=K, first_step_quirk=False)
+
+    bigs = [make_data(rng, K * B) for _ in range(2)]
+    scan_fn = accumulate_scan(loss_fn, opt, cfg)
+    sc = scan_init(params, opt)
+    for big in bigs:
+        sc, _ = scan_fn(sc, stack_micro_batches(big, K))
+
+    stream_fn = jax.jit(streaming_step(loss_fn, opt, cfg))
+    s = streaming_init(params, opt)
+    applied = []
+    for big in bigs:
+        for i in range(K):
+            micro = jax.tree.map(lambda a: a[i * B : (i + 1) * B], big)
+            s, aux = stream_fn(s, micro)
+            applied.append(float(aux["applied"]))
+    assert applied == ([0.0] * (K - 1) + [1.0]) * 2
+    assert int(s.step) == 2 * K == int(sc.step)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        s.params,
+        sc.params,
+    )
+    # accumulators zeroed after apply (optimization.py:87)
+    assert all(
+        np.allclose(np.asarray(a), 0.0) for a in jax.tree.leaves(s.accum_grads)
+    )
+
+
+def test_streaming_first_step_quirk(rng):
+    """Step 0 applies with ONE micro-batch normalized by 1/K (SURVEY.md §0)."""
+    params = make_params(rng)
+    data = make_data(rng, B)
+    opt = sgd(1.0)
+    cfg = GradAccumConfig(num_micro_batches=K, first_step_quirk=True)
+
+    stream_fn = streaming_step(loss_fn, opt, cfg)
+    s, aux = stream_fn(streaming_init(params, opt), data)
+    assert float(aux["applied"]) == 1.0
+    g = jax.grad(loss_fn)(params, data)
+    expected = jax.tree.map(lambda p, gg: p - gg / K, params, g)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        s.params,
+        expected,
+    )
+
+
+def test_streaming_apply_cadence_with_quirk(rng):
+    """Apply fires at steps 0, K, 2K, ... (optimization.py:91 + 102)."""
+    params = make_params(rng)
+    data = make_data(rng, B)
+    cfg = GradAccumConfig(num_micro_batches=3, first_step_quirk=True)
+    opt = sgd(0.01)
+    stream_fn = jax.jit(streaming_step(loss_fn, opt, cfg))
+    s = streaming_init(params, opt)
+    pattern = []
+    for _ in range(7):
+        s, aux = stream_fn(s, data)
+        pattern.append(int(aux["applied"]))
+    assert pattern == [1, 0, 0, 1, 0, 0, 1]
+
+
+def test_streaming_adam_update_count_only_on_apply(rng):
+    """Adam's bias-correction t advances per UPDATE, not per micro-batch."""
+    params = make_params(rng)
+    data = make_data(rng, B)
+    opt = adam(1e-3)
+    cfg = GradAccumConfig(num_micro_batches=K, first_step_quirk=False)
+    stream_fn = jax.jit(streaming_step(loss_fn, opt, cfg))
+    s = streaming_init(params, opt)
+    for _ in range(2 * K):
+        s, _ = stream_fn(s, data)
+    assert int(s.opt_state.t) == 2
+    assert int(s.step) == 2 * K
+
+
+def test_clip_after_average_not_per_micro_batch(rng):
+    """Clipping applies to the averaged grad (optimization.py:83-84).
+
+    Construct micro-batches whose individual grads exceed the clip norm but
+    whose average does not: per-micro clipping would distort, clip-after-
+    average must be a no-op."""
+    params = {"w": jnp.zeros((1,))}
+
+    def lf(p, batch):
+        return jnp.mean(batch["g"] * p["w"])  # grad == mean(batch["g"])
+
+    big = {"g": jnp.asarray([[10.0], [-10.0], [9.0], [-9.0]], jnp.float32)}
+    cfg = GradAccumConfig(num_micro_batches=4, clip_norm=1.0)
+    opt = sgd(1.0)
+    state, aux = accumulate_scan(lf, opt, cfg)(
+        scan_init(params, opt), stack_micro_batches(big, 4)
+    )
+    # avg grad = 0 -> no clip, no movement
+    np.testing.assert_allclose(np.asarray(state.params["w"]), 0.0, atol=1e-7)
+
+    big2 = {"g": jnp.full((4, 1), 8.0, jnp.float32)}  # avg grad = 8 -> clipped to 1
+    state2, _ = accumulate_scan(lf, opt, cfg)(
+        scan_init(params, opt), stack_micro_batches(big2, 4)
+    )
+    np.testing.assert_allclose(np.asarray(state2.params["w"]), -1.0, rtol=1e-6)
+
+
+def test_stack_micro_batches_shapes():
+    batch = {"x": jnp.zeros((12, 5)), "y": jnp.zeros((12, 1))}
+    stacked = stack_micro_batches(batch, 3)
+    assert stacked["x"].shape == (3, 4, 5)
+    assert stacked["y"].shape == (3, 4, 1)

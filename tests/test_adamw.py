@@ -1,0 +1,112 @@
+"""AdamW / Adam unit tests vs hand-computed numpy steps.
+
+The critical semantics under test (optimization.py:107-194): NO bias
+correction, decoupled weight decay applied after the m/v math, and
+name-regex-based decay exclusion.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gradaccum_tpu.ops.adamw import adam, adamw, sgd
+
+
+def _np_adamw_step(p, g, m, v, lr, wd, b1=0.9, b2=0.999, eps=1e-6, decay=True):
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    upd = m2 / (np.sqrt(v2) + eps)
+    if decay:
+        upd = upd + wd * p
+    return p - lr * upd, m2, v2
+
+
+def test_adamw_matches_hand_computed_no_bias_correction(rng):
+    params = {"w": jnp.asarray(rng.normal(size=(4, 3)), jnp.float32),
+              "bias": jnp.asarray(rng.normal(size=(3,)), jnp.float32)}
+    grads = {"w": jnp.asarray(rng.normal(size=(4, 3)), jnp.float32),
+             "bias": jnp.asarray(rng.normal(size=(3,)), jnp.float32)}
+    opt = adamw(learning_rate=0.1, weight_decay_rate=0.01)
+    state = opt.init(params)
+    new_params, new_state = jax.jit(opt.update)(grads, state, params, 0)
+
+    # "w": decayed; "bias": matched by the exclusion regex -> no decay
+    exp_w, exp_m, exp_v = _np_adamw_step(
+        np.asarray(params["w"]), np.asarray(grads["w"]), 0.0, 0.0, 0.1, 0.01
+    )
+    exp_b, _, _ = _np_adamw_step(
+        np.asarray(params["bias"]), np.asarray(grads["bias"]), 0.0, 0.0, 0.1,
+        0.01, decay=False,
+    )
+    np.testing.assert_allclose(new_params["w"], exp_w, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(new_params["bias"], exp_b, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(new_state.m["w"], exp_m, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(new_state.v["w"], exp_v, rtol=1e-5, atol=1e-6)
+
+    # Second step must still use raw moments (no 1/(1-beta^t) anywhere).
+    p2, s2 = jax.jit(opt.update)(grads, new_state, new_params, 1)
+    exp_w2, _, _ = _np_adamw_step(exp_w, np.asarray(grads["w"]), exp_m, exp_v, 0.1, 0.01)
+    np.testing.assert_allclose(p2["w"], exp_w2, rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_exclusion_regex_layer_norm(rng):
+    params = {"encoder": {"LayerNorm": {"scale": jnp.ones((3,))},
+                          "layer_norm_alt": {"gamma": jnp.ones((3,))},
+                          "dense": {"kernel": jnp.ones((3,))}}}
+    grads = jax.tree.map(jnp.zeros_like, params)
+    opt = adamw(learning_rate=1.0, weight_decay_rate=0.5)
+    state = opt.init(params)
+    new_params, _ = opt.update(grads, state, params, 0)
+    # zero grad => update is pure weight decay where enabled
+    np.testing.assert_allclose(new_params["encoder"]["LayerNorm"]["scale"], 1.0)
+    np.testing.assert_allclose(new_params["encoder"]["layer_norm_alt"]["gamma"], 1.0)
+    np.testing.assert_allclose(new_params["encoder"]["dense"]["kernel"], 0.5)
+
+
+def test_adamw_schedule_driven_lr():
+    params = {"w": jnp.ones((2,))}
+    grads = {"w": jnp.zeros((2,))}
+    opt = adamw(lambda step: 0.1 * step.astype(jnp.float32),
+                weight_decay_rate=1.0, exclude_from_weight_decay=())
+    state = opt.init(params)
+    p1, _ = opt.update(grads, state, params, 0)  # lr 0 -> no change
+    np.testing.assert_allclose(p1["w"], 1.0)
+    p2, _ = opt.update(grads, state, params, 1)  # lr 0.1, wd 1.0 -> p *= 0.9
+    np.testing.assert_allclose(p2["w"], 0.9, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_bias_correction_matches_tf_formulation(rng):
+    p = np.asarray(rng.normal(size=(5,)), np.float32)
+    g = np.asarray(rng.normal(size=(5,)), np.float32)
+    opt = adam(learning_rate=1e-3)
+    state = opt.init({"p": jnp.asarray(p)})
+    params = {"p": jnp.asarray(p)}
+    m = v = np.zeros_like(p)
+    for t in range(1, 4):
+        params, state = jax.jit(opt.update)({"p": jnp.asarray(g)}, state, params, 0)
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        alpha = 1e-3 * np.sqrt(1 - 0.999**t) / (1 - 0.9**t)
+        p = p - alpha * m / (np.sqrt(v) + 1e-8)
+        np.testing.assert_allclose(np.asarray(params["p"]), p, rtol=1e-5)
+    assert int(state.t) == 3
+
+
+def test_adam_t_independent_of_schedule_step():
+    # The update count lives in opt state, not in the caller's step counter.
+    params = {"p": jnp.ones((2,))}
+    grads = {"p": jnp.full((2,), 0.5)}
+    opt = adam(1e-2)
+    s = opt.init(params)
+    p_a, s_a = opt.update(grads, s, params, 999)
+    p_b, s_b = opt.update(grads, s, params, 0)
+    np.testing.assert_allclose(p_a["p"], p_b["p"])
+
+
+def test_sgd():
+    params = {"p": jnp.ones((2,))}
+    grads = {"p": jnp.full((2,), 0.5)}
+    opt = sgd(0.1)
+    s = opt.init(params)
+    p, _ = opt.update(grads, s, params, 0)
+    np.testing.assert_allclose(p["p"], 0.95)

@@ -1,0 +1,52 @@
+"""Schedule unit tests vs hand-computed values (optimization.py:29-54)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from gradaccum_tpu.ops.schedule import (
+    as_schedule,
+    constant,
+    polynomial_decay,
+    warmup_polynomial_decay,
+)
+
+
+def test_polynomial_decay_linear():
+    sched = polynomial_decay(1.0, decay_steps=100)
+    assert np.isclose(sched(jnp.asarray(0)), 1.0)
+    assert np.isclose(sched(jnp.asarray(50)), 0.5)
+    assert np.isclose(sched(jnp.asarray(100)), 0.0)
+    # cycle=False: clamps past the horizon
+    assert np.isclose(sched(jnp.asarray(250)), 0.0)
+
+
+def test_polynomial_decay_power_and_end():
+    sched = polynomial_decay(1.0, decay_steps=100, end_value=0.1, power=2.0)
+    assert np.isclose(sched(jnp.asarray(50)), 0.9 * 0.25 + 0.1)
+
+
+def test_warmup_blend_boundaries():
+    # BERT-style: lr 2e-5, warmup 10, total 100.
+    sched = warmup_polynomial_decay(2e-5, 100, num_warmup_steps=10)
+    # step 0: warmup branch, lr = 0 (init_lr * 0/10)
+    assert np.isclose(sched(jnp.asarray(0)), 0.0)
+    # mid-warmup: linear ramp
+    assert np.isclose(sched(jnp.asarray(5)), 2e-5 * 0.5)
+    # the mask is step < warmup (optimization.py:52): at step==warmup we are on
+    # the decay branch already
+    assert np.isclose(sched(jnp.asarray(10)), 2e-5 * (1 - 10 / 100))
+    assert np.isclose(sched(jnp.asarray(9)), 2e-5 * 0.9, rtol=1e-6)
+    # end of schedule: decayed to zero
+    assert np.isclose(sched(jnp.asarray(100)), 0.0)
+
+
+def test_no_warmup_is_pure_decay():
+    sched = warmup_polynomial_decay(1.0, 10, num_warmup_steps=0)
+    assert np.isclose(sched(jnp.asarray(5)), 0.5)
+
+
+def test_as_schedule_lifts_floats():
+    sched = as_schedule(3e-4)
+    assert np.isclose(sched(jnp.asarray(7)), 3e-4)
+    sched2 = as_schedule(constant(1e-3))
+    assert np.isclose(sched2(jnp.asarray(7)), 1e-3)
