@@ -32,10 +32,17 @@ branch (optimization.py:91-94). Preserved fine print (SURVEY.md §0):
   micro-batches.
 
 **Data parallelism**: pass ``axis_name`` when the step runs under
-``shard_map``/``pmap`` over a mesh axis. Gradients are accumulated locally
-(one collective per K micro-batches, not per micro-batch) and ``pmean``-ed at
-apply time — the ICI equivalent of the reference's SUM-aggregated mirrored
+``shard_map`` over a mesh axis. JAX's varying-manual-axes (VMA) machinery
+auto-psums the cotangent of replica-invariant params, so naive ``jax.grad``
+inside shard_map costs one all-reduce per micro-batch. Scan mode avoids that:
+params are ``lax.pcast``-ed to axis-varying before differentiation, so the K
+micro-batch gradients accumulate locally and a single explicit ``psum`` fires
+at apply time — one collective per optimizer update over ICI, the moral
+equivalent of (but cheaper than) the reference's SUM-aggregated mirrored
 accumulators + 1/num_workers loss scaling (distributedExample/04:46,55).
+Streaming mode keeps the reference's cost model too: mirrored-variable
+aggregation fired on every ``assign_add``, and here the auto-psum fires per
+micro-batch call — accumulators stay replica-invariant.
 """
 
 from __future__ import annotations
@@ -68,17 +75,12 @@ class GradAccumConfig(NamedTuple):
 LossFn = Callable[[Any, Any], jnp.ndarray]
 
 
-def _sync_grads(grads, axis_name):
-    if axis_name is None:
-        return grads
-    return lax.pmean(grads, axis_name)
-
-
-def _finalize(grads, config: GradAccumConfig):
-    """normalize-by-K → cross-replica mean → optional clip (optimization.py:83-84)."""
-    k = float(config.num_micro_batches)
-    grads = jax.tree.map(lambda g: g / k, grads)
-    grads = _sync_grads(grads, config.axis_name)
+def _finalize(grads, config: GradAccumConfig, denom):
+    """normalize accumulated-grad sum by ``denom`` → optional clip
+    (optimization.py:83-84). ``denom`` folds the 1/K normalization together
+    with the cross-replica 1/N (the reference's 04:46 loss scaling)."""
+    denom = float(denom) if not hasattr(denom, "dtype") else denom
+    grads = jax.tree.map(lambda g: g / denom, grads)
     if config.clip_norm is not None:
         grads, norm = clip_by_global_norm(grads, config.clip_norm)
     else:
@@ -123,6 +125,7 @@ def accumulate_scan(
     """
     k = config.num_micro_batches
     grad_fn = jax.value_and_grad(loss_fn)
+    axis = config.axis_name
 
     def train_step(state: ScanState, super_batch):
         leading = {x.shape[0] for x in jax.tree.leaves(super_batch)}
@@ -132,14 +135,28 @@ def accumulate_scan(
                 f"leading dims {sorted(leading)}. Use stack_micro_batches(batch, K)."
             )
 
+        # Differentiate w.r.t. axis-VARYING params so per-micro-batch grads
+        # stay local to the replica (no auto-psum inside the scan body); one
+        # explicit psum below covers the whole accumulated sum.
+        diff_params = (
+            jax.tree.map(lambda p: lax.pcast(p, axis, to="varying"), state.params)
+            if axis is not None
+            else state.params
+        )
+
         def body(accum, micro_batch):
-            loss, grads = grad_fn(state.params, micro_batch)
+            loss, grads = grad_fn(diff_params, micro_batch)
             accum = jax.tree.map(jnp.add, accum, grads)
             return accum, loss
 
-        accum0 = tree_zeros_like(state.params)
+        accum0 = tree_zeros_like(diff_params)
         accum, losses = lax.scan(body, accum0, super_batch, length=k)
-        grads, norm = _finalize(accum, config)
+        if axis is not None:
+            accum = lax.psum(accum, axis)  # the one collective per update
+            denom = k * lax.axis_size(axis)
+        else:
+            denom = k
+        grads, norm = _finalize(accum, config, denom)
         apply_step = state.step + k
         new_params, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params, apply_step
@@ -207,15 +224,24 @@ def streaming_step(
     # `state.step + K`.
     step_offset = 0 if config.first_step_quirk else 1
 
+    axis = config.axis_name
+
     def train_step(state: StreamingState, micro_batch):
+        # Under shard_map, state.params are replica-invariant, so VMA
+        # auto-psums these grads across the axis: they arrive as the SUM of
+        # per-replica local gradients — exactly the reference's
+        # aggregation=SUM mirrored accumulators (04:55), and the same cost
+        # model (one aggregation per micro-batch assign_add). The 1/N
+        # (04:46's loss scaling) folds into the apply-time denominator.
         loss, grads = grad_fn(state.params, micro_batch)
+        apply_denom = k * (lax.axis_size(axis) if axis is not None else 1)
 
         def apply_branch(operand):
             params, opt_state, accum = operand
             # (a) re-accumulate the current grad first (optimization.py:81)
             accum = jax.tree.map(jnp.add, accum, grads)
             # (b)-(c) normalize, cross-replica mean, clip (optimization.py:83-84)
-            avg, _ = _finalize(accum, config)
+            avg, _ = _finalize(accum, config, apply_denom)
             # (d) apply (optimization.py:85); schedule sees the micro-batch step
             new_params, new_opt_state = optimizer.update(
                 avg, opt_state, params, state.step + step_offset
@@ -242,9 +268,9 @@ def streaming_step(
             accum_grads=new_accum,
             step=state.step + 1,
         )
-        # aux loss is replica-local on purpose: collectives fire once per K
-        # micro-batches (inside _finalize), never per micro-batch. Callers
-        # aggregate losses across replicas at logging time if they care.
+        # aux loss is replica-local on purpose (the gradient auto-psum is the
+        # only collective this step emits); the DP wrapper pmeans it for
+        # logging, single-device callers use it as-is.
         return new_state, {
             "loss": loss,
             "applied": applied.astype(jnp.float32),

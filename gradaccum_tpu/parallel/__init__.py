@@ -1,0 +1,19 @@
+from gradaccum_tpu.parallel import dp, mesh, sharding
+from gradaccum_tpu.parallel.dp import make_dp_train_step, make_pjit_dp_train_step
+from gradaccum_tpu.parallel.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    data_parallel_mesh,
+    make_mesh,
+)
+from gradaccum_tpu.parallel.sharding import (
+    batch_sharding,
+    device_put_batch,
+    host_shard,
+    param_shardings,
+    replicated,
+    shard_params,
+)

@@ -1,0 +1,81 @@
+"""Device meshes — the TPU-native replacement for the reference's cluster.
+
+The reference's distribution layer is a two-worker
+``MultiWorkerMirroredStrategy`` with RING collectives configured via a
+``TF_CONFIG`` cluster spec (/root/reference/distributedExample/03:68-89,
+04:98-119). On TPU the cluster is a ``jax.sharding.Mesh`` over the slice's
+devices; XLA emits bidirectional-ring reduces over ICI for ``psum`` — the
+moral equivalent of the reference's ring all-reduce, chosen by the compiler
+instead of a strategy object.
+
+Canonical axis names used across the framework:
+
+- ``data``   — data parallelism (the reference's worker axis)
+- ``model``  — tensor parallelism (not in the reference; first-class here)
+- ``seq``    — sequence/context parallelism (ring attention)
+- ``expert`` — expert parallelism
+- ``pipe``   — pipeline stages
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
+
+
+def make_mesh(
+    axis_sizes: Optional[Sequence[Tuple[str, int]]] = None,
+    *,
+    devices=None,
+    **axes: int,
+) -> Mesh:
+    """Build a mesh from ``(name, size)`` pairs or keyword axes.
+
+    A single ``-1`` size absorbs all remaining devices, e.g.
+    ``make_mesh(data=-1)`` or ``make_mesh(data=-1, model=2)``.
+    """
+    if axis_sizes is None:
+        axis_sizes = list(axes.items())
+    elif axes:
+        raise ValueError("pass axis_sizes or keyword axes, not both")
+    if not axis_sizes:
+        axis_sizes = [(DATA_AXIS, -1)]
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    names = [n for n, _ in axis_sizes]
+    sizes = [s for _, s in axis_sizes]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if len(devices) % known:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by fixed axes {known}"
+            )
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} need exactly {total} devices, "
+            f"have {len(devices)}; use -1 to absorb the remainder or pass an "
+            "explicit devices= subset"
+        )
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def data_parallel_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh over the ``data`` axis — the reference's only topology."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return make_mesh([(DATA_AXIS, len(devices))], devices=devices)

@@ -13,6 +13,16 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax
+
+# The axon TPU plugin's sitecustomize forces jax_platforms at interpreter
+# startup (before conftest runs), so the env var alone is too late — override
+# the config back to CPU before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    f"tests need the 8-device virtual CPU mesh, got {jax.devices()}"
+)
+
 import numpy as np
 import pytest
 

@@ -1,0 +1,227 @@
+"""Data-parallel layer tests on the 8-device virtual CPU mesh (SURVEY.md §4 (d)).
+
+Core invariant: DP over N replicas + accumulation over K micro-batches must
+equal a single-device step on the concatenated batch — the reference's
+4-way effective-batch-200 equivalence matrix (README.md:135-139), shrunk.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from gradaccum_tpu.ops.accumulation import (
+    GradAccumConfig,
+    accumulate_scan,
+    scan_init,
+    stack_micro_batches,
+    streaming_init,
+    streaming_step,
+)
+from gradaccum_tpu.ops.adamw import adamw, sgd
+from gradaccum_tpu.ops.schedule import warmup_polynomial_decay
+from gradaccum_tpu.parallel.dp import make_dp_train_step, make_pjit_dp_train_step
+from gradaccum_tpu.parallel.mesh import data_parallel_mesh, make_mesh
+from gradaccum_tpu.parallel.sharding import (
+    device_put_batch,
+    host_shard,
+    param_shardings,
+    shard_params,
+)
+
+D = 8  # virtual devices (conftest)
+K = 2
+B = 4  # per-replica micro-batch
+
+
+def loss_fn(params, batch):
+    pred = batch["x"] @ params["w"] + params["bias"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def make_params(rng):
+    return {
+        "w": jnp.asarray(rng.normal(size=(3, 1)), jnp.float32),
+        "bias": jnp.zeros((1,), jnp.float32),
+    }
+
+
+def make_batch(rng, n):
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (x @ np.asarray([[1.0], [-2.0], [0.5]], np.float32)).astype(np.float32)
+    return {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+
+
+def _single_device_reference(params, opt, big, k):
+    cfg = GradAccumConfig(num_micro_batches=k, clip_norm=1.0)
+    state, aux = accumulate_scan(loss_fn, opt, cfg)(
+        scan_init(params, opt), stack_micro_batches(big, k)
+    )
+    return state, aux
+
+
+@pytest.fixture
+def mesh():
+    return data_parallel_mesh()
+
+
+def _opt():
+    sched = warmup_polynomial_decay(1e-2, 100, num_warmup_steps=10)
+    return adamw(sched, weight_decay_rate=0.01)
+
+
+def test_shard_map_dp_scan_equals_single_device(rng, mesh):
+    params = make_params(rng)
+    opt = _opt()
+    # global super-batch: K micro-batches of D*B rows each
+    big = make_batch(rng, K * D * B)
+    ref_state, ref_aux = _single_device_reference(params, opt, big, K)
+
+    cfg = GradAccumConfig(num_micro_batches=K, clip_norm=1.0)
+    step = make_dp_train_step(loss_fn, opt, cfg, mesh, mode="scan")
+    state = scan_init(params, opt)
+    batch = device_put_batch(
+        stack_micro_batches(big, K), mesh, leading_unsharded=1
+    )
+    new_state, aux = step(state, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        new_state.params,
+        ref_state.params,
+    )
+    np.testing.assert_allclose(float(aux["loss"]), float(ref_aux["loss"]), rtol=1e-5)
+
+
+def test_pjit_dp_scan_equals_single_device(rng, mesh):
+    params = make_params(rng)
+    opt = _opt()
+    big = make_batch(rng, K * D * B)
+    ref_state, _ = _single_device_reference(params, opt, big, K)
+
+    cfg = GradAccumConfig(num_micro_batches=K, clip_norm=1.0)
+    step = make_pjit_dp_train_step(loss_fn, opt, cfg, mesh, mode="scan")
+    state = scan_init(params, opt)
+    batch = device_put_batch(
+        stack_micro_batches(big, K), mesh, leading_unsharded=1
+    )
+    new_state, _ = step(state, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        new_state.params,
+        ref_state.params,
+    )
+
+
+def test_shard_map_dp_streaming_equals_single_device(rng, mesh):
+    """Streaming DP: accumulators mirror the reference's SUM aggregation."""
+    params = make_params(rng)
+    opt = _opt()
+    cfg = GradAccumConfig(
+        num_micro_batches=K, clip_norm=1.0, first_step_quirk=False
+    )
+    step = make_dp_train_step(loss_fn, opt, cfg, mesh, mode="streaming")
+
+    micros = [make_batch(rng, D * B) for _ in range(K)]
+    big = jax.tree.map(lambda *xs: jnp.concatenate(xs), *micros)
+    # reference first: the DP step donates its state, whose buffers alias params
+    ref_state, _ = _single_device_reference(params, opt, big, K)
+
+    state = streaming_init(params, opt)
+    for m in micros:
+        state, aux = step(state, device_put_batch(m, mesh))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        state.params,
+        ref_state.params,
+    )
+
+
+def test_effective_batch_equivalence_matrix(rng):
+    """The reference's 4-way matrix (README.md:135-139), one update cycle.
+
+    All four (replicas, per-replica batch, K) combos with effective batch 64
+    produce the SAME parameter update from the same data and params."""
+    params_np = jax.device_get(make_params(rng))
+    big = make_batch(rng, 64)
+    opt = sgd(0.1)
+
+    results = {}
+    for n_dev, k in [(1, 1), (1, 2), (8, 1), (8, 2)]:
+        # fresh param buffers per combo: each step donates its state
+        params = jax.tree.map(jnp.asarray, params_np)
+        mesh = data_parallel_mesh(n_dev)
+        cfg = GradAccumConfig(num_micro_batches=k)
+        step = make_dp_train_step(loss_fn, opt, cfg, mesh, mode="scan")
+        batch = device_put_batch(
+            stack_micro_batches(big, k), mesh, leading_unsharded=1
+        )
+        state, _ = step(scan_init(params, opt), batch)
+        results[(n_dev, k)] = jax.device_get(state.params)
+
+    base = results[(1, 1)]
+    for key, val in results.items():
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-5, atol=1e-6, err_msg=f"combo {key}"
+            ),
+            val,
+            base,
+        )
+
+
+def test_params_stay_replicated_across_steps(rng, mesh):
+    params = make_params(rng)
+    opt = _opt()
+    cfg = GradAccumConfig(num_micro_batches=K)
+    step = make_dp_train_step(loss_fn, opt, cfg, mesh, mode="scan")
+    state = scan_init(params, opt)
+    for _ in range(3):
+        big = make_batch(rng, K * D * B)
+        batch = device_put_batch(
+            stack_micro_batches(big, K), mesh, leading_unsharded=1
+        )
+        state, _ = step(state, batch)
+    # fully addressable + replicated: every device shard identical
+    w = state.params["w"]
+    shards = [np.asarray(s.data) for s in w.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(s, shards[0])
+    assert int(state.step) == 3 * K
+
+
+def test_host_shard_parity_with_input_context(rng):
+    """host_shard slices like InputContext.shard (01:13-15)."""
+    batch = {"x": jnp.arange(12).reshape(12, 1)}
+    s0 = host_shard(batch, num_hosts=3, host_id=0)
+    s2 = host_shard(batch, num_hosts=3, host_id=2)
+    np.testing.assert_array_equal(np.asarray(s0["x"]).ravel(), [0, 1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(s2["x"]).ravel(), [8, 9, 10, 11])
+    with pytest.raises(ValueError):
+        host_shard(batch, num_hosts=5, host_id=0)
+
+
+def test_param_sharding_rules(rng):
+    mesh = make_mesh(data=4, model=2)
+    params = {
+        "dense": {"kernel": jnp.zeros((4, 8)), "bias": jnp.zeros((8,))},
+        "emb": {"table": jnp.zeros((16, 4))},
+    }
+    rules = [(r"dense/kernel", P(None, "model")), (r"emb", P("model", None))]
+    sh = param_shardings(params, mesh, rules)
+    assert sh["dense"]["kernel"].spec == P(None, "model")
+    assert sh["dense"]["bias"].spec == P()
+    assert sh["emb"]["table"].spec == P("model", None)
+    placed = shard_params(params, mesh, rules)
+    assert placed["dense"]["kernel"].sharding.spec == P(None, "model")
+
+
+def test_mesh_construction():
+    m = make_mesh(data=-1)
+    assert m.shape == {"data": 8}
+    m2 = make_mesh(data=-1, model=2)
+    assert m2.shape == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        make_mesh(data=-1, model=-1)
+    with pytest.raises(ValueError):
+        make_mesh(data=16)
