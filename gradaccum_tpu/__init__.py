@@ -25,5 +25,8 @@ from gradaccum_tpu.ops.accumulation import (
 )
 from gradaccum_tpu.ops.adamw import adam, adamw
 from gradaccum_tpu.ops.schedule import warmup_polynomial_decay
+from gradaccum_tpu.data.pipeline import Dataset
+from gradaccum_tpu.estimator.config import EvalSpec, RunConfig, TrainSpec
+from gradaccum_tpu.estimator.estimator import Estimator, ModelBundle
 
 __version__ = "0.1.0"

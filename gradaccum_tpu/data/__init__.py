@@ -1,0 +1,10 @@
+from gradaccum_tpu.data import csv, mnist, pipeline
+from gradaccum_tpu.data.csv import (
+    FeatureColumns,
+    housing_feature_columns,
+    load_housing,
+    process_features,
+    read_csv,
+)
+from gradaccum_tpu.data.mnist import load as load_mnist
+from gradaccum_tpu.data.pipeline import Dataset

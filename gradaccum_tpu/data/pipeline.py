@@ -1,0 +1,237 @@
+"""Array-backed input pipeline with tf.data semantics.
+
+The reference delegates its input pipelines to tf.data's C++ runtime
+(shard → shuffle → batch → repeat in distributedExample/01:6-18; shuffle →
+batch → map → repeat for the CSV path, another-example.py:40-56). This module
+re-creates those operators over in-memory NumPy arrays, preserving the
+behaviors the experiments depend on:
+
+- ``shard(num, index)`` — every ``num``-th example, as
+  ``tf.data.Dataset.shard`` / ``InputContext`` does (01:13-15).
+- ``shuffle(buffer_size, seed)`` — *buffered* shuffle with tf.data's
+  reservoir semantics (the reference uses ``2*batch+1`` buffers,
+  another-example.py:44, 01:16), reseeded per epoch.
+- ``batch(n, drop_remainder)`` — gather-based, vectorized.
+- ``map(fn)`` — applied wherever it sits in the chain; the CSV pipeline
+  batches *before* mapping (another-example.py:46-49) and that order is
+  honored here.
+- ``repeat(count)`` — re-runs the upstream chain, advancing shuffle seeds.
+- ``prefetch(n)`` — background-thread prefetch (the Python stand-in for the
+  native async loader in ``native/``).
+
+Ops compose in call order, exactly like tf.data. Iterating yields pytrees of
+NumPy arrays ready for ``jax.device_put``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+
+def _num_examples(data) -> int:
+    import jax
+
+    leaves = jax.tree.leaves(data)
+    if not leaves:
+        raise ValueError("empty dataset")
+    n = leaves[0].shape[0]
+    for leaf in leaves[1:]:
+        if leaf.shape[0] != n:
+            raise ValueError("dataset leaves disagree on leading dim")
+    return n
+
+
+def _gather(data, idx):
+    import jax
+
+    return jax.tree.map(lambda a: a[idx], data)
+
+
+class Dataset:
+    """A lazily-evaluated op chain over an in-memory pytree of arrays."""
+
+    def __init__(self, data, ops=None):
+        self._data = data
+        self._n = _num_examples(data)
+        self._ops = list(ops or [])
+
+    @classmethod
+    def from_arrays(cls, data) -> "Dataset":
+        return cls(data)
+
+    def _with(self, op) -> "Dataset":
+        return Dataset(self._data, self._ops + [op])
+
+    # -- operators (tf.data parity) -------------------------------------
+
+    def shard(self, num_shards: int, index: int) -> "Dataset":
+        if not 0 <= index < num_shards:
+            raise ValueError(f"shard index {index} not in [0, {num_shards})")
+        return self._with(("shard", num_shards, index))
+
+    def shuffle(self, buffer_size: int, seed: Optional[int] = None) -> "Dataset":
+        return self._with(("shuffle", buffer_size, seed))
+
+    def batch(self, batch_size: int, drop_remainder: bool = False) -> "Dataset":
+        return self._with(("batch", batch_size, drop_remainder))
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return self._with(("map", fn))
+
+    def repeat(self, count: Optional[int] = None) -> "Dataset":
+        return self._with(("repeat", count))
+
+    def prefetch(self, n: int = 2) -> "Dataset":
+        return self._with(("prefetch", n))
+
+    def take(self, n: int) -> "Dataset":
+        return self._with(("take", n))
+
+    # -- evaluation ------------------------------------------------------
+
+    def _build(self, ops, epoch: int) -> Iterator[Any]:
+        """Build the iterator for ``ops``; ``epoch`` advances shuffle seeds.
+
+        The stream starts as example indices (a fast path: batching gathers
+        rows vectorized); the first ``map`` or ``batch`` materializes
+        elements/batches and later ops work on pytrees.
+        """
+        stream: Iterator[Any] = iter(range(self._n))
+        is_index_stream = True
+
+        for i, op in enumerate(ops):
+            kind = op[0]
+            if kind == "shard":
+                # tf.data shards by element POSITION (01:13-15), which also
+                # holds after shuffle/map — enumerate, don't use index values
+                _, num, index = op
+                stream = (x for pos, x in enumerate(stream) if pos % num == index)
+            elif kind == "shuffle":
+                _, buf, seed = op
+                stream = _buffered_shuffle(stream, buf, seed, epoch)
+            elif kind == "batch":
+                _, bs, drop = op
+                stream = self._batch_stream(stream, bs, drop, is_index_stream)
+                is_index_stream = False
+            elif kind == "map":
+                _, fn = op
+                if is_index_stream:
+                    stream = (fn(_gather(self._data, j)) for j in stream)
+                    is_index_stream = False
+                else:
+                    stream = (fn(x) for x in stream)
+            elif kind == "repeat":
+                _, count = op
+                return self._repeat_stream(ops[:i], ops[i + 1 :], count, epoch)
+            elif kind == "take":
+                _, n = op
+                stream = _take(stream, n)
+            elif kind == "prefetch":
+                _, n = op
+                stream = _prefetch(stream, n)
+            else:  # pragma: no cover
+                raise AssertionError(kind)
+        if is_index_stream:
+            stream = (_gather(self._data, j) for j in stream)
+        return stream
+
+    def _batch_stream(self, stream, batch_size, drop_remainder, is_index_stream):
+        def emit(buf):
+            if is_index_stream:
+                return _gather(self._data, np.asarray(buf))
+            import jax
+
+            return jax.tree.map(lambda *xs: np.stack(xs), *buf)
+
+        buf = []
+        for item in stream:
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield emit(buf)
+                buf = []
+        if buf and not drop_remainder:
+            yield emit(buf)
+
+    def _repeat_stream(self, upstream_ops, downstream, count, epoch):
+        def epochs():
+            e = epoch
+            while count is None or e < epoch + count:
+                yield from self._build(upstream_ops, e)
+                e += 1
+
+        # downstream ops (e.g. CSV's map-after-batch → repeat tail) apply to
+        # the concatenated epoch stream of materialized elements/batches
+        stream = epochs()
+        for op in downstream:
+            kind = op[0]
+            if kind == "map":
+                stream = (op[1](x) for x in stream)
+            elif kind == "take":
+                stream = _take(stream, op[1])
+            elif kind == "prefetch":
+                stream = _prefetch(stream, op[1])
+            elif kind == "batch":
+                stream = self._batch_stream(
+                    stream, op[1], op[2], is_index_stream=False
+                )
+            else:
+                raise ValueError(f"{kind}() after repeat() is not supported")
+        return stream
+
+    def __iter__(self):
+        return iter(self._build(self._ops, epoch=0))
+
+
+def _take(stream, n):
+    for i, x in enumerate(stream):
+        if i >= n:
+            return
+        yield x
+
+
+def _buffered_shuffle(stream, buffer_size, seed, epoch):
+    """tf.data reservoir shuffle: keep a buffer, emit a random element as
+    each new one arrives. Seed advances per epoch (reshuffle_each_iteration
+    semantics, the tf.data default)."""
+    rng = np.random.default_rng(
+        None if seed is None else np.random.SeedSequence([seed, epoch])
+    )
+    buf = []
+    for x in stream:
+        buf.append(x)
+        if len(buf) > buffer_size:
+            k = int(rng.integers(len(buf)))
+            buf[k], buf[-1] = buf[-1], buf[k]
+            yield buf.pop()
+    order = rng.permutation(len(buf))
+    for k in order:
+        yield buf[k]
+
+
+def _prefetch(stream, n):
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, n))
+    sentinel = object()
+    error = []
+
+    def worker():
+        try:
+            for x in stream:
+                q.put(x)
+        except BaseException as e:  # propagate to consumer
+            error.append(e)
+        finally:
+            q.put(sentinel)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        x = q.get()
+        if x is sentinel:
+            if error:
+                raise error[0]
+            return
+        yield x

@@ -1,0 +1,11 @@
+from gradaccum_tpu.estimator import checkpoint, config, estimator, metrics
+from gradaccum_tpu.estimator.checkpoint import latest_checkpoint, restore, save
+from gradaccum_tpu.estimator.config import EvalSpec, RunConfig, TrainSpec
+from gradaccum_tpu.estimator.estimator import Estimator, ModelBundle
+from gradaccum_tpu.estimator.metrics import (
+    accuracy,
+    add_metrics,
+    mean_absolute_error,
+    mean_loss,
+    root_mean_squared_error,
+)

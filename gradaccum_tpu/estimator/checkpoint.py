@@ -1,0 +1,76 @@
+"""Checkpoint save/restore of full TrainState pytrees.
+
+The reference delegates checkpointing to Estimator's ``model_dir``
+(/root/reference/another-example.py:283-287): auto-save during training,
+auto-restore on resume and before every evaluate/predict. Critically, the
+accumulator variables and adam_m/adam_v slots are ordinary variables there,
+so they checkpoint too and **resume mid-accumulation-cycle is exact**
+(SURVEY.md §5). Here the entire state pytree — params, optimizer moments,
+accumulators, step — is one atomically-written msgpack file per step, so the
+same guarantee holds by construction.
+
+Layout: ``<dir>/ckpt-<step>.msgpack`` (+ ``.tmp`` during write). Restore
+deserializes into a template pytree (``flax.serialization`` keeps arrays as
+numpy; callers jit them back to device on first use).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, List, Optional, Tuple
+
+import jax
+from flax import serialization
+
+_CKPT_RE = re.compile(r"ckpt-(\d+)\.msgpack$")
+
+
+def save(directory: str, state: Any, step: int, keep: int = 5) -> str:
+    """Atomically write ``state`` at ``step``; prune to ``keep`` newest."""
+    os.makedirs(directory, exist_ok=True)
+    state = jax.device_get(state)
+    path = os.path.join(directory, f"ckpt-{step}.msgpack")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.to_bytes(state))
+    os.replace(tmp, path)
+    if keep:
+        for _, old in all_checkpoints(directory)[:-keep]:
+            os.remove(old)
+    return path
+
+
+def all_checkpoints(directory: str) -> List[Tuple[int, str]]:
+    """(step, path) pairs, oldest first."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        m = _CKPT_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def latest_checkpoint(directory: str) -> Optional[Tuple[int, str]]:
+    ckpts = all_checkpoints(directory)
+    return ckpts[-1] if ckpts else None
+
+
+def restore(directory_or_path: str, template: Any) -> Any:
+    """Restore the newest checkpoint (or an explicit file) into ``template``.
+
+    Raises FileNotFoundError when the directory holds no checkpoints — the
+    caller decides whether cold-start is acceptable (Estimator does, matching
+    the reference's fresh-model_dir behavior).
+    """
+    if os.path.isfile(directory_or_path):
+        path = directory_or_path
+    else:
+        found = latest_checkpoint(directory_or_path)
+        if found is None:
+            raise FileNotFoundError(f"no checkpoints under {directory_or_path}")
+        _, path = found
+    with open(path, "rb") as f:
+        return serialization.from_bytes(template, f.read())

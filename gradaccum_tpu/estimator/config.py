@@ -1,0 +1,35 @@
+"""Run configuration and train/eval specs.
+
+Parity with the reference's harness knobs: ``tf.estimator.RunConfig``
+(/root/reference/another-example.py:283-287 — model_dir, tf_random_seed,
+log_step_count_steps) and ``TrainSpec``/``EvalSpec``
+(another-example.py:299-320 — max_steps, eval steps, throttle_secs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class RunConfig:
+    model_dir: Optional[str] = None
+    seed: int = 19830610  # the reference's tf_random_seed (01:77 etc.)
+    log_step_count_steps: int = 100  # steps/sec logging cadence (01:76)
+    save_checkpoints_steps: Optional[int] = 1000
+    keep_checkpoint_max: int = 5
+
+
+@dataclass
+class TrainSpec:
+    input_fn: Callable[[], Any]  # () -> iterable of batches
+    max_steps: Optional[int] = None  # counted in MICRO-batches (reference semantics)
+
+
+@dataclass
+class EvalSpec:
+    input_fn: Callable[[], Any]
+    steps: Optional[int] = None  # None = run the iterable out
+    throttle_secs: int = 30  # min seconds between evals (another-example.py:318)
+    name: str = "eval"

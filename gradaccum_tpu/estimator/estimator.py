@@ -1,0 +1,363 @@
+"""Estimator-shaped training harness.
+
+TPU-native rebuild of the ``tf.estimator`` layer the reference leans on
+(/root/reference/another-example.py:186-190, 299-342; distributedExample/02:
+96-140): a train/eval/predict loop with checkpoint auto-save/auto-restore,
+throttled evaluation, streaming metrics, steps/sec logging, and seed control
+— but state-explicit and functionally pure inside one jitted step.
+
+Key semantic carried over: **steps count micro-batches** (the reference's
+``global_step``, optimization.py:102-103). ``max_steps`` and checkpoint /
+logging cadences are micro-batch counts in both accumulation modes; in scan
+mode each host step advances the counter by K.
+
+The model contract replaces ``model_fn(features, labels, mode) ->
+EstimatorSpec`` with an explicit :class:`ModelBundle`; the three Estimator
+modes map to its fields (TRAIN → ``loss``, EVAL → ``predict`` +
+``eval_metrics``, PREDICT → ``predict``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from gradaccum_tpu.estimator import checkpoint as ckpt_lib
+from gradaccum_tpu.estimator.config import EvalSpec, RunConfig, TrainSpec
+from gradaccum_tpu.estimator.metrics import Metric
+from gradaccum_tpu.ops import accumulation as acc
+from gradaccum_tpu.ops.adamw import Optimizer
+from gradaccum_tpu.parallel.dp import make_dp_train_step
+from gradaccum_tpu.parallel.sharding import device_put_batch
+
+
+class ModelBundle(NamedTuple):
+    """Everything the harness needs to know about a model."""
+
+    init: Callable[[jax.Array, Any], Any]  # (rng, sample_batch) -> params
+    loss: Callable[[Any, Any], jnp.ndarray]  # (params, batch) -> scalar
+    predict: Callable[[Any, Any], Dict[str, Any]]  # (params, batch) -> outputs
+    eval_metrics: Dict[str, Metric]
+    needs_rng: bool = False  # if True, batches get an "rng" key folded per step
+
+
+class Estimator:
+    """``Estimator(model, optimizer, accum, config)`` — harness entrypoint.
+
+    ``mode``: ``"streaming"`` (reference tf.cond semantics, one micro-batch
+    per host step) or ``"scan"`` (K micro-batches fused into one XLA step —
+    the TPU-native hot path). ``mesh``: optional ``jax.sharding.Mesh`` with a
+    ``data`` axis for data-parallel training (the reference's
+    MultiWorkerMirroredStrategy slot, 03:76).
+    """
+
+    def __init__(
+        self,
+        model: ModelBundle,
+        optimizer: Optimizer,
+        accum: acc.GradAccumConfig,
+        config: Optional[RunConfig] = None,
+        mesh=None,
+        mode: str = "streaming",
+    ):
+        if mode not in ("streaming", "scan"):
+            raise ValueError(f"mode must be 'streaming' or 'scan', got {mode!r}")
+        self.model = model
+        self.optimizer = optimizer
+        self.accum = accum
+        self.config = config or RunConfig()
+        self.mesh = mesh
+        self.mode = mode
+        self._train_step = None
+        self._eval_step = None
+        self._state = None  # last trained/restored state
+
+    # -- state ----------------------------------------------------------
+
+    def _loss_fn(self):
+        return self.model.loss
+
+    def _init_state(self, sample_batch):
+        rng = jax.random.PRNGKey(self.config.seed)
+        params = self.model.init(rng, sample_batch)
+        if self.mode == "scan":
+            return acc.scan_init(params, self.optimizer)
+        return acc.streaming_init(params, self.optimizer)
+
+    def _maybe_restore(self, template):
+        d = self.config.model_dir
+        if d and ckpt_lib.latest_checkpoint(d):
+            state = ckpt_lib.restore(d, jax.device_get(template))
+            return jax.tree.map(jnp.asarray, state)
+        return None
+
+    # -- step builders ---------------------------------------------------
+
+    def _build_train_step(self):
+        if self._train_step is not None:
+            return self._train_step
+        loss_fn = self._loss_fn()
+        needs_rng = self.model.needs_rng
+        if self.mesh is not None:
+            step = make_dp_train_step(
+                loss_fn, self.optimizer, self.accum, self.mesh,
+                mode=self.mode, needs_rng=needs_rng,
+            )
+        else:
+            builder = (
+                acc.accumulate_scan if self.mode == "scan" else acc.streaming_step
+            )
+            step = jax.jit(
+                builder(loss_fn, self.optimizer, self.accum, needs_rng=needs_rng),
+                donate_argnums=0,
+            )
+        self._train_step = step
+        return step
+
+    def _build_eval_step(self):
+        if self._eval_step is not None:
+            return self._eval_step
+        predict = self.model.predict
+        metrics = self.model.eval_metrics
+
+        @jax.jit
+        def eval_step(params, batch):
+            outputs = predict(params, batch)
+            return {name: m.update(outputs, batch) for name, m in metrics.items()}
+
+        self._eval_step = eval_step
+        return eval_step
+
+    # -- batches ---------------------------------------------------------
+
+    def _prep_batch(self, batch, step_no):
+        """Returns the positional args after ``state`` for the train step."""
+        if self.mode == "scan":
+            batch = acc.stack_micro_batches(batch, self.accum.num_micro_batches)
+        if self.mesh is not None:
+            batch = device_put_batch(
+                batch,
+                self.mesh,
+                leading_unsharded=1 if self.mode == "scan" else 0,
+            )
+        if self.model.needs_rng:
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(self.config.seed + 1), step_no
+            )
+            return (batch, rng)
+        return (batch,)
+
+    # -- public API (Estimator parity) ------------------------------------
+
+    def train(self, input_fn, max_steps: Optional[int] = None, state=None):
+        """Train until ``max_steps`` micro-batches (or the input runs out).
+
+        Resumes from the newest checkpoint in ``model_dir`` when present —
+        including mid-accumulation-cycle accumulator state (SURVEY.md §5).
+        """
+        cfg = self.config
+        it = iter(input_fn() if callable(input_fn) else input_fn)
+        pending = None
+        if state is None:
+            state = self._state
+        if state is None:
+            pending = next(it, None)
+            if pending is None:
+                raise ValueError("input_fn yielded no batches")
+            state = self._init_state(self._sample_micro(pending))
+            restored = self._maybe_restore(state)
+            if restored is not None:
+                state = restored
+        step_fn = self._build_train_step()
+
+        k = self.accum.num_micro_batches if self.mode == "scan" else 1
+        log_every = max(cfg.log_step_count_steps, 1)
+        t0 = time.time()
+        # track the micro-step counter host-side: it advances by exactly k per
+        # call, so the hot loop never blocks on a device read
+        step_no = int(jax.device_get(state.step))
+        steps_at_t0 = step_no
+        last_logged_bucket = step_no // log_every
+        loss_rows = []  # (step, device scalar) — fetched lazily
+        micro_size = None
+
+        while True:
+            if max_steps is not None and step_no >= max_steps:
+                break
+            batch = pending if pending is not None else next(it, None)
+            pending = None
+            if batch is None:
+                break
+            if micro_size is None:
+                micro_size = self._micro_size(batch)
+            state, aux = step_fn(state, *self._prep_batch(batch, step_no))
+            step_no += k
+            loss_rows.append((step_no, aux["loss"]))
+            bucket = step_no // log_every
+            if bucket != last_logged_bucket:
+                dt = time.time() - t0
+                rate = (step_no - steps_at_t0) / max(dt, 1e-9)
+                loss = float(jax.device_get(aux["loss"]))
+                print(
+                    f"[train] step={step_no} loss={loss:.5f} "
+                    f"steps/sec={rate:.2f} examples/sec={rate * micro_size:.1f}"
+                )
+                last_logged_bucket = bucket
+            if (
+                cfg.model_dir
+                and cfg.save_checkpoints_steps
+                and step_no % cfg.save_checkpoints_steps < k
+            ):
+                ckpt_lib.save(cfg.model_dir, state, step_no, cfg.keep_checkpoint_max)
+
+        if cfg.model_dir:
+            ckpt_lib.save(cfg.model_dir, state, step_no, cfg.keep_checkpoint_max)
+            self._append_loss_csv(
+                [(s, float(v)) for s, v in jax.device_get(loss_rows)]
+            )
+        self._state = state
+        return state
+
+    def evaluate(
+        self,
+        input_fn,
+        steps: Optional[int] = None,
+        state=None,
+        checkpoint_path: Optional[str] = None,
+        name: str = "eval",
+    ) -> Dict[str, float]:
+        """Run streaming metrics over the eval input (Estimator.evaluate).
+
+        Like the reference, prefers the newest checkpoint in ``model_dir``
+        (another-example.py:361-370 depends on that behavior) unless an
+        explicit ``state`` is given.
+        """
+        it = iter(input_fn() if callable(input_fn) else input_fn)
+        first = next(it, None)
+        if first is None:
+            raise ValueError("eval input_fn yielded no batches")
+        params = self._params_for_inference(first, state, checkpoint_path)
+        eval_step = self._build_eval_step()
+
+        totals: Dict[str, Any] = {}
+        n_batches = 0
+        batch = first
+        while batch is not None:
+            if steps is not None and n_batches >= steps:
+                break
+            parts = jax.device_get(eval_step(params, batch))
+            for key, (total, count) in parts.items():
+                t, c = totals.get(key, (0.0, 0.0))
+                totals[key] = (t + total, c + count)
+            n_batches += 1
+            batch = next(it, None)
+
+        results = {
+            key: float(self.model.eval_metrics[key].finalize(jnp.asarray(t), jnp.asarray(c)))
+            for key, (t, c) in totals.items()
+        }
+        print(f"[{name}] " + " ".join(f"{k}={v:.5f}" for k, v in results.items()))
+        results["_num_batches"] = n_batches
+        return results
+
+    def predict(
+        self, input_fn, state=None, checkpoint_path: Optional[str] = None
+    ) -> Iterator[Dict[str, Any]]:
+        """Yield per-example output dicts (Estimator.predict semantics,
+        another-example.py:385-389)."""
+        it = iter(input_fn() if callable(input_fn) else input_fn)
+        first = next(it, None)
+        if first is None:
+            return
+        params = self._params_for_inference(first, state, checkpoint_path)
+        predict = jax.jit(self.model.predict)
+        batch = first
+        while batch is not None:
+            outputs = jax.device_get(predict(params, batch))
+            n = len(jax.tree.leaves(outputs)[0])
+            for i in range(n):
+                yield jax.tree.map(lambda x: x[i], outputs)
+            batch = next(it, None)
+
+    def train_and_evaluate(self, train_spec: TrainSpec, eval_spec: EvalSpec):
+        """``tf.estimator.train_and_evaluate`` parity: train in chunks,
+        evaluating at most every ``throttle_secs`` (another-example.py:318),
+        plus a final eval."""
+        import itertools
+
+        last_eval = 0.0
+        results = None
+        it = iter(train_spec.input_fn())
+        k = self.accum.num_micro_batches if self.mode == "scan" else 1
+        chunk = max(self.config.log_step_count_steps, k)
+
+        while True:
+            state = self.train(
+                itertools.islice(it, max(chunk // k, 1)),
+                max_steps=train_spec.max_steps,
+            )
+            done_steps = int(jax.device_get(state.step))
+            peeked = next(it, None)
+            if peeked is not None:
+                it = itertools.chain([peeked], it)
+            if (
+                train_spec.max_steps is not None
+                and done_steps >= train_spec.max_steps
+            ) or peeked is None:
+                results = self.evaluate(
+                    eval_spec.input_fn, steps=eval_spec.steps, state=state,
+                    name=eval_spec.name,
+                )
+                return state, results
+            if time.time() - last_eval >= eval_spec.throttle_secs:
+                results = self.evaluate(
+                    eval_spec.input_fn, steps=eval_spec.steps, state=state,
+                    name=eval_spec.name,
+                )
+                last_eval = time.time()
+
+    # -- helpers ---------------------------------------------------------
+
+    def _sample_micro(self, batch):
+        if self.mode == "scan":
+            return jax.tree.map(
+                lambda x: x[: max(1, x.shape[0] // self.accum.num_micro_batches)],
+                batch,
+            )
+        return batch
+
+    def _micro_size(self, batch):
+        leaf = jax.tree.leaves(batch)[0]
+        n = leaf.shape[0]
+        return n // (self.accum.num_micro_batches if self.mode == "scan" else 1)
+
+    def _params_for_inference(self, sample_batch, state, checkpoint_path):
+        if state is not None:
+            return state.params
+        if checkpoint_path or (
+            self.config.model_dir and ckpt_lib.latest_checkpoint(self.config.model_dir)
+        ):
+            template = jax.device_get(
+                self._state or self._init_state(self._sample_micro(sample_batch))
+            )
+            restored = ckpt_lib.restore(
+                checkpoint_path or self.config.model_dir, template
+            )
+            return jax.tree.map(jnp.asarray, restored.params)
+        if self._state is not None:
+            return self._state.params
+        return self._init_state(self._sample_micro(sample_batch)).params
+
+    def _append_loss_csv(self, rows):
+        """loss-vs-step CSV — the data behind the reference's PNG curves."""
+        path = os.path.join(self.config.model_dir, "loss_vs_step.csv")
+        new = not os.path.exists(path)
+        with open(path, "a") as f:
+            if new:
+                f.write("step,loss\n")
+            for step, loss in rows:
+                f.write(f"{step},{loss}\n")

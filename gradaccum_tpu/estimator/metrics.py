@@ -1,0 +1,90 @@
+"""Streaming evaluation metrics.
+
+The reference uses TF1 streaming metrics — ``tf.compat.v1.metrics.accuracy``
+(/root/reference/distributedExample/02:75-76) and
+``mean_absolute_error`` / ``root_mean_squared_error`` attached via
+``tf.contrib.estimator.add_metrics`` (another-example.py:172-181). Those all
+reduce to (total, count) accumulator pairs updated per batch and finalized at
+the end; that is exactly the representation here, as a pytree so the update
+runs inside ``jit`` and sums correctly across uneven final batches (and, via
+psum, across mesh shards).
+
+A metric is ``Metric(update, finalize)`` where ``update(outputs, batch) ->
+(total, count)`` maps one batch to partial sums, and ``finalize(total, count)
+-> scalar``. Batch totals are summed on the host across batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple
+
+import jax.numpy as jnp
+
+
+class Metric(NamedTuple):
+    update: Callable[[Any, Any], tuple]
+    finalize: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def _count(x):
+    return jnp.asarray(x.shape[0], jnp.float32)
+
+
+def accuracy(pred_key: str = "classes", label_key: str = "label") -> Metric:
+    """tf.metrics.accuracy parity (02:75-76): running correct/total."""
+
+    def update(outputs, batch):
+        correct = jnp.sum(
+            (outputs[pred_key].reshape(-1) == batch[label_key].reshape(-1)).astype(
+                jnp.float32
+            )
+        )
+        return correct, _count(batch[label_key])
+
+    return Metric(update, lambda total, count: total / count)
+
+
+def mean_absolute_error(pred_key: str = "predictions", label_key: str = "label") -> Metric:
+    """tf.metrics.mean_absolute_error parity (another-example.py:176)."""
+
+    def update(outputs, batch):
+        err = jnp.sum(
+            jnp.abs(outputs[pred_key].reshape(-1) - batch[label_key].reshape(-1))
+        )
+        return err, _count(batch[label_key])
+
+    return Metric(update, lambda total, count: total / count)
+
+
+def root_mean_squared_error(
+    pred_key: str = "predictions", label_key: str = "label"
+) -> Metric:
+    """tf.metrics.root_mean_squared_error parity (another-example.py:179)."""
+
+    def update(outputs, batch):
+        err = jnp.sum(
+            jnp.square(outputs[pred_key].reshape(-1) - batch[label_key].reshape(-1))
+        )
+        return err, _count(batch[label_key])
+
+    return Metric(update, lambda total, count: jnp.sqrt(total / count))
+
+
+def mean_loss(loss_key: str = "loss") -> Metric:
+    """Streaming mean of a per-batch scalar (weighted by batch size)."""
+
+    def update(outputs, batch):
+        import jax
+
+        n = _count(jax.tree.leaves(batch)[0])
+        return outputs[loss_key] * n, n
+
+    return Metric(update, lambda total, count: total / count)
+
+
+def add_metrics(metrics: Dict[str, Metric], extra: Dict[str, Metric]) -> Dict[str, Metric]:
+    """``tf.contrib.estimator.add_metrics`` parity (another-example.py:172-195):
+    overlay extra metrics on an existing metric dict, new keys winning."""
+    out = dict(metrics)
+    out.update(extra)
+    return out

@@ -72,7 +72,15 @@ class GradAccumConfig(NamedTuple):
 
 
 # loss_fn(params, micro_batch) -> scalar loss (mean over the micro batch).
+# Stochastic models (dropout) read micro_batch["rng"]; see needs_rng below.
 LossFn = Callable[[Any, Any], jnp.ndarray]
+
+
+def _with_rng(batch, key):
+    """Inject a PRNG key into a dict micro-batch (requires dict batches)."""
+    if not isinstance(batch, dict):
+        raise TypeError("needs_rng requires dict batches (to carry the 'rng' key)")
+    return dict(batch, rng=key)
 
 
 def _finalize(grads, config: GradAccumConfig, denom):
@@ -111,7 +119,8 @@ def accumulate_scan(
     loss_fn: LossFn,
     optimizer: Optimizer,
     config: GradAccumConfig,
-) -> Callable[[ScanState, Any], tuple]:
+    needs_rng: bool = False,
+) -> Callable[..., tuple]:
     """Build the scan-mode train step.
 
     The returned ``train_step(state, super_batch)`` expects every leaf of
@@ -122,12 +131,18 @@ def accumulate_scan(
     the *end* of the cycle — the same step value at which the reference's
     steady-state apply branch fires (it applies at ``global_step == m*K``,
     the last micro-batch of cycle m; optimization.py:91).
+
+    With ``needs_rng=True`` the signature becomes
+    ``train_step(state, super_batch, rng)``: the key is split into K
+    per-micro-batch keys fed through the scan, and each dict micro-batch
+    reaches ``loss_fn`` with an ``"rng"`` entry. The key rides outside the
+    batch so data-parallel wrappers can replicate it instead of sharding it.
     """
     k = config.num_micro_batches
     grad_fn = jax.value_and_grad(loss_fn)
     axis = config.axis_name
 
-    def train_step(state: ScanState, super_batch):
+    def train_step(state: ScanState, super_batch, rng=None):
         leading = {x.shape[0] for x in jax.tree.leaves(super_batch)}
         if leading != {k}:
             raise ValueError(
@@ -144,13 +159,23 @@ def accumulate_scan(
             else state.params
         )
 
-        def body(accum, micro_batch):
+        if needs_rng:
+            if rng is None:
+                raise ValueError("needs_rng=True: pass train_step(state, batch, rng)")
+            xs = (super_batch, jax.random.split(rng, k))
+        else:
+            xs = (super_batch, None)
+
+        def body(accum, x):
+            micro_batch, key = x
+            if key is not None:
+                micro_batch = _with_rng(micro_batch, key)
             loss, grads = grad_fn(diff_params, micro_batch)
             accum = jax.tree.map(jnp.add, accum, grads)
             return accum, loss
 
         accum0 = tree_zeros_like(diff_params)
-        accum, losses = lax.scan(body, accum0, super_batch, length=k)
+        accum, losses = lax.scan(body, accum0, xs, length=k)
         if axis is not None:
             accum = lax.psum(accum, axis)  # the one collective per update
             denom = k * lax.axis_size(axis)
@@ -206,11 +231,13 @@ def streaming_step(
     loss_fn: LossFn,
     optimizer: Optimizer,
     config: GradAccumConfig,
-) -> Callable[[StreamingState, Any], tuple]:
+    needs_rng: bool = False,
+) -> Callable[..., tuple]:
     """Build the streaming-mode train step (one micro-batch per call).
 
     Mirrors optimization.py:76-103 exactly; see module docstring for the
-    preserved fine print. ``aux["applied"]`` is 1.0 on apply steps.
+    preserved fine print. ``aux["applied"]`` is 1.0 on apply steps. With
+    ``needs_rng=True`` the signature is ``train_step(state, batch, rng)``.
     """
     k = config.num_micro_batches
     grad_fn = jax.value_and_grad(loss_fn)
@@ -226,7 +253,11 @@ def streaming_step(
 
     axis = config.axis_name
 
-    def train_step(state: StreamingState, micro_batch):
+    def train_step(state: StreamingState, micro_batch, rng=None):
+        if needs_rng:
+            if rng is None:
+                raise ValueError("needs_rng=True: pass train_step(state, batch, rng)")
+            micro_batch = _with_rng(micro_batch, rng)
         # Under shard_map, state.params are replica-invariant, so VMA
         # auto-psums these grads across the axis: they arrive as the SUM of
         # per-replica local gradients — exactly the reference's

@@ -40,20 +40,28 @@ def make_dp_train_step(
     mesh: Mesh,
     mode: str = "scan",
     axis: str = DATA_AXIS,
+    needs_rng: bool = False,
 ):
-    """Explicit-collective DP step via shard_map. See module docstring."""
+    """Explicit-collective DP step via shard_map. See module docstring.
+
+    With ``needs_rng=True`` the step signature is
+    ``train_step(state, batch, rng)``; the key is replicated across the mesh
+    (every replica derives the same per-micro-batch dropout keys — batches
+    differ per replica, so noise decorrelates through the data, matching the
+    reference where each worker owns its own graph-level random ops).
+    """
     config = config._replace(axis_name=axis)
     if mode == "scan":
-        inner = acc.accumulate_scan(loss_fn, optimizer, config)
+        inner = acc.accumulate_scan(loss_fn, optimizer, config, needs_rng=needs_rng)
         batch_spec = P(None, axis)  # [K, B, ...]: shard the micro-batch dim
         # scan mode already pmeans its aux loss; everything else is invariant
         step = inner
     elif mode == "streaming":
-        inner = acc.streaming_step(loss_fn, optimizer, config)
+        inner = acc.streaming_step(loss_fn, optimizer, config, needs_rng=needs_rng)
         batch_spec = P(axis)  # [B, ...]
 
-        def step(state, batch):
-            new_state, aux = inner(state, batch)
+        def step(state, batch, *rng):
+            new_state, aux = inner(state, batch, *rng)
             # streaming aux loss is replica-local; make the logged value global
             aux = dict(aux, loss=lax.pmean(aux["loss"], axis))
             return new_state, aux
@@ -61,10 +69,11 @@ def make_dp_train_step(
     else:
         raise ValueError(f"mode must be 'scan' or 'streaming', got {mode!r}")
 
+    in_specs = (P(), batch_spec) + ((P(),) if needs_rng else ())
     sharded = jax.shard_map(
         step,
         mesh=mesh,
-        in_specs=(P(), batch_spec),
+        in_specs=in_specs,
         out_specs=(P(), P()),
     )
     return jax.jit(sharded, donate_argnums=0)
@@ -77,6 +86,7 @@ def make_pjit_dp_train_step(
     mesh: Mesh,
     mode: str = "scan",
     axis: str = DATA_AXIS,
+    needs_rng: bool = False,
 ):
     """GSPMD DP step: single-device code + shardings; XLA adds collectives.
 
@@ -87,18 +97,19 @@ def make_pjit_dp_train_step(
     """
     config = config._replace(axis_name=None)
     if mode == "scan":
-        inner = acc.accumulate_scan(loss_fn, optimizer, config)
+        inner = acc.accumulate_scan(loss_fn, optimizer, config, needs_rng=needs_rng)
         batch_shard = batch_sharding(mesh, axis, leading_unsharded=1)
     elif mode == "streaming":
-        inner = acc.streaming_step(loss_fn, optimizer, config)
+        inner = acc.streaming_step(loss_fn, optimizer, config, needs_rng=needs_rng)
         batch_shard = batch_sharding(mesh, axis)
     else:
         raise ValueError(f"mode must be 'scan' or 'streaming', got {mode!r}")
 
     rep = replicated(mesh)
+    in_shardings = (rep, batch_shard) + ((rep,) if needs_rng else ())
     return jax.jit(
         inner,
-        in_shardings=(rep, batch_shard),
+        in_shardings=in_shardings,
         out_shardings=(rep, rep),
         donate_argnums=0,
     )

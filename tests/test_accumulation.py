@@ -199,3 +199,45 @@ def test_stack_micro_batches_shapes():
     stacked = stack_micro_batches(batch, 3)
     assert stacked["x"].shape == (3, 4, 5)
     assert stacked["y"].shape == (3, 4, 1)
+
+
+def test_needs_rng_scan_per_micro_batch_keys(rng):
+    """Each micro-batch sees a distinct key; same (state, batch, rng) is
+    deterministic."""
+    import jax.random as jrandom
+
+    seen = []
+
+    def lf(params, batch):
+        seen.append(None)
+        noise = jrandom.normal(batch["rng"], ())
+        return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2) + 0.0 * noise
+
+    params = make_params(rng)
+    big = make_data(rng, K * B)
+    opt = sgd(0.01)
+    step = jax.jit(
+        accumulate_scan(lf, opt, GradAccumConfig(num_micro_batches=K), needs_rng=True)
+    )
+    key = jax.random.PRNGKey(0)
+    s1, _ = step(scan_init(params, opt), stack_micro_batches(big, K), key)
+    s2, _ = step(scan_init(params, opt), stack_micro_batches(big, K), key)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        s1.params,
+        s2.params,
+    )
+
+
+def test_needs_rng_missing_key_raises(rng):
+    params = make_params(rng)
+    opt = sgd(0.01)
+    step = accumulate_scan(
+        lambda p, b: loss_fn(p, b), opt, GradAccumConfig(num_micro_batches=K),
+        needs_rng=True,
+    )
+    big = make_data(rng, K * B)
+    import pytest
+
+    with pytest.raises(ValueError, match="needs_rng"):
+        step(scan_init(params, opt), stack_micro_batches(big, K))

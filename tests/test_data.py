@@ -1,0 +1,214 @@
+"""Data-layer tests: idx parsing, CSV features, pipeline op semantics."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from gradaccum_tpu.data.csv import (
+    FeatureColumns,
+    housing_feature_columns,
+    load_housing,
+    process_features,
+    read_csv,
+)
+from gradaccum_tpu.data.mnist import load, read_images, read_labels, synthetic
+from gradaccum_tpu.data.pipeline import Dataset
+
+
+# -- MNIST idx format ----------------------------------------------------
+
+
+def _write_idx(tmp_path, gz=True):
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(5, 28, 28), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=5, dtype=np.uint8)
+    img_bytes = struct.pack(">iiii", 2051, 5, 28, 28) + images.tobytes()
+    lbl_bytes = struct.pack(">ii", 2049, 5) + labels.tobytes()
+    opener = gzip.open if gz else open
+    suffix = ".gz" if gz else ""
+    ipath = str(tmp_path / f"train-images-idx3-ubyte{suffix}")
+    lpath = str(tmp_path / f"train-labels-idx1-ubyte{suffix}")
+    with opener(ipath, "wb") as f:
+        f.write(img_bytes)
+    with opener(lpath, "wb") as f:
+        f.write(lbl_bytes)
+    return ipath, lpath, images, labels
+
+
+@pytest.mark.parametrize("gz", [True, False])
+def test_read_idx_roundtrip(tmp_path, gz):
+    ipath, lpath, images, labels = _write_idx(tmp_path, gz)
+    imgs = read_images(ipath)
+    lbls = read_labels(lpath)
+    assert imgs.shape == (5, 28, 28, 1) and imgs.dtype == np.float32
+    np.testing.assert_allclose(
+        imgs[..., 0], images.astype(np.float32) / 255.0, rtol=1e-6
+    )
+    np.testing.assert_array_equal(lbls, labels.astype(np.int32))
+    assert imgs.min() >= 0.0 and imgs.max() <= 1.0
+
+
+def test_read_idx_bad_magic(tmp_path):
+    path = str(tmp_path / "bad.gz")
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">iiii", 1234, 1, 28, 28) + b"\0" * 784)
+    with pytest.raises(ValueError, match="magic"):
+        read_images(path)
+
+
+def test_synthetic_fallback_deterministic():
+    a = synthetic(num_train=64, num_test=16)
+    b = synthetic(num_train=64, num_test=16)
+    np.testing.assert_array_equal(a["train"][0], b["train"][0])
+    assert a["train"][0].shape == (64, 28, 28, 1)
+    assert set(np.unique(a["train"][1])) <= set(range(10))
+    assert load(None)["train"][0].shape[1:] == (28, 28, 1)
+
+
+# -- CSV / feature columns ----------------------------------------------
+
+
+def test_read_csv_and_transforms(tmp_path):
+    p = tmp_path / "housing.csv"
+    p.write_text(
+        "CRIM,ZN,INDUS,CHAS,NOX,RM,AGE,DIS,RAD,TAX,PTRATIO,B,LSTAT,MEDV\n"
+        "1.0,2,3,0,4,5,6,7,8,9,10,250,12,24.0\n"
+        "2.718281828,2,3,1,4,5,6,7,8,9,10,550,12,30.0\n"
+    )
+    cols = read_csv(str(p))
+    assert cols["CRIM"].dtype == np.float32
+    assert list(cols["CHAS"]) == ["0", "1"]
+    out = process_features(cols)
+    # log CRIM (another-example.py:77), clip B to [300,500] (:78)
+    np.testing.assert_allclose(out["CRIM"], [0.0, 1.0], atol=1e-6)
+    np.testing.assert_allclose(out["B"], [300.0, 500.0])
+    # original dict untouched
+    np.testing.assert_allclose(cols["B"], [250.0, 550.0])
+
+
+def test_feature_columns_one_hot():
+    fc = FeatureColumns(["a"], {"c": ["x", "y"]})
+    dense = fc({"a": np.asarray([1.0, 2.0]), "c": np.asarray(["y", "z"])})
+    assert fc.width == 3
+    np.testing.assert_allclose(dense, [[1.0, 0.0, 1.0], [2.0, 0.0, 0.0]])
+
+
+def test_housing_loader_shapes():
+    X, y = load_housing()
+    fc = housing_feature_columns()
+    assert X.shape == (506, fc.width) and fc.width == 14  # 12 numeric + 2 CHAS
+    assert y.shape == (506, 1)
+    assert np.isfinite(X).all()
+
+
+# -- pipeline ------------------------------------------------------------
+
+
+def _data(n=10):
+    return {"x": np.arange(n, dtype=np.float32), "y": np.arange(n) * 10}
+
+
+def test_batch_and_remainder():
+    ds = Dataset.from_arrays(_data(10)).batch(4)
+    batches = list(ds)
+    assert [len(b["x"]) for b in batches] == [4, 4, 2]
+    ds2 = Dataset.from_arrays(_data(10)).batch(4, drop_remainder=True)
+    assert [len(b["x"]) for b in list(ds2)] == [4, 4]
+
+
+def test_shard_every_nth():
+    """tf.data shard semantics: element i goes to shard i % num (01:13-15)."""
+    ds = Dataset.from_arrays(_data(10)).shard(2, 1).batch(10)
+    (b,) = list(ds)
+    np.testing.assert_array_equal(b["x"], [1, 3, 5, 7, 9])
+
+
+def test_shuffle_is_permutation_and_seeded():
+    ds = Dataset.from_arrays(_data(20)).shuffle(7, seed=3).batch(20)
+    (a,) = list(ds)
+    (b,) = list(Dataset.from_arrays(_data(20)).shuffle(7, seed=3).batch(20))
+    np.testing.assert_array_equal(a["x"], b["x"])  # same seed, same order
+    assert sorted(a["x"].tolist()) == list(range(20))  # a permutation
+    assert a["x"].tolist() != list(range(20))  # actually shuffled
+
+
+def test_repeat_reshuffles_each_epoch():
+    ds = Dataset.from_arrays(_data(8)).shuffle(8, seed=1).repeat(2).batch(8)
+    e1, e2 = list(ds)
+    assert sorted(e1["x"].tolist()) == sorted(e2["x"].tolist())
+    assert e1["x"].tolist() != e2["x"].tolist()
+
+
+def test_csv_order_batch_then_map_then_repeat():
+    """The CSV pipeline batches BEFORE map (another-example.py:46-49)."""
+    seen_shapes = []
+
+    def fn(batch):
+        seen_shapes.append(batch["x"].shape)
+        return {"x": batch["x"] * 2, "y": batch["y"]}
+
+    ds = Dataset.from_arrays(_data(6)).batch(3).map(fn).repeat(2)
+    out = list(ds)
+    assert len(out) == 4  # 2 batches × 2 epochs
+    assert all(s == (3,) for s in seen_shapes)
+    np.testing.assert_array_equal(out[0]["x"], [0, 2, 4])
+
+
+def test_infinite_repeat_with_take():
+    ds = Dataset.from_arrays(_data(4)).repeat().batch(4).take(5)
+    assert len(list(ds)) == 5
+
+
+def test_prefetch_transparent():
+    ds = Dataset.from_arrays(_data(10)).batch(3).prefetch(2)
+    plain = Dataset.from_arrays(_data(10)).batch(3)
+    for a, b in zip(ds, plain):
+        np.testing.assert_array_equal(a["x"], b["x"])
+
+
+def test_mnist_reference_chain():
+    """The 01:6-18 chain: shard → shuffle(2B+1) → batch(B) → repeat."""
+    images, labels = synthetic(num_train=40, num_test=8)["train"]
+    B = 8
+    ds = (
+        Dataset.from_arrays({"image": images, "label": labels})
+        .shard(2, 0)
+        .shuffle(2 * B + 1, seed=19830610)
+        .batch(B)
+        .repeat(2)
+    )
+    batches = list(ds)
+    # 20 examples per shard → 3 batches/epoch (8,8,4) × 2 epochs
+    assert [len(b["label"]) for b in batches] == [8, 8, 4, 8, 8, 4]
+
+
+def test_map_before_batch_elementwise():
+    """tf.data parity: map over elements, then batch collates mapped items."""
+    ds = Dataset.from_arrays(_data(6)).map(lambda e: {"x": e["x"] + 100}).batch(3)
+    out = list(ds)
+    assert len(out) == 2
+    np.testing.assert_array_equal(out[0]["x"], [100, 101, 102])
+    assert out[0]["x"].shape == (3,)
+
+
+def test_map_alone_yields_unbatched_elements():
+    ds = Dataset.from_arrays(_data(3)).map(lambda e: e)
+    elems = list(ds)
+    assert len(elems) == 3
+    assert np.isscalar(elems[0]["x"]) or elems[0]["x"].shape == ()
+
+
+def test_map_then_repeat_then_batch():
+    ds = Dataset.from_arrays(_data(4)).map(lambda e: e).repeat(2).batch(4)
+    out = list(ds)
+    assert [len(b["x"]) for b in out] == [4, 4]
+
+
+def test_shard_by_position_after_shuffle():
+    """Position-based sharding: both shards together cover the dataset."""
+    a = list(Dataset.from_arrays(_data(10)).shuffle(10, seed=2).shard(2, 0).batch(10))[0]
+    b = list(Dataset.from_arrays(_data(10)).shuffle(10, seed=2).shard(2, 1).batch(10))[0]
+    combined = sorted(a["x"].tolist() + b["x"].tolist())
+    assert combined == list(range(10))

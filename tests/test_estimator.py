@@ -1,0 +1,188 @@
+"""Harness tests: train/eval/predict loop, checkpoint-resume, metrics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gradaccum_tpu.data.pipeline import Dataset
+from gradaccum_tpu.estimator.checkpoint import all_checkpoints, restore, save
+from gradaccum_tpu.estimator.config import EvalSpec, RunConfig, TrainSpec
+from gradaccum_tpu.estimator.estimator import Estimator, ModelBundle
+from gradaccum_tpu.estimator.metrics import (
+    accuracy,
+    add_metrics,
+    mean_absolute_error,
+    root_mean_squared_error,
+)
+from gradaccum_tpu.ops.accumulation import GradAccumConfig
+from gradaccum_tpu.ops.adamw import adam, sgd
+
+K = 2
+B = 8
+
+
+def _linear_bundle():
+    def init(rng, sample):
+        del rng, sample
+        return {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}
+
+    def loss(params, batch):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def predict(params, batch):
+        return {"predictions": batch["x"] @ params["w"] + params["b"]}
+
+    return ModelBundle(
+        init=init,
+        loss=loss,
+        predict=predict,
+        eval_metrics={
+            "mae": mean_absolute_error(label_key="y"),
+            "rmse": root_mean_squared_error(label_key="y"),
+        },
+    )
+
+
+def _regression_data(rng, n):
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (x @ np.asarray([[1.0], [-2.0], [0.5]], np.float32)).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def _input_fn(rng, n, batch, epochs=None, seed=7):
+    data = _regression_data(rng, n)
+
+    def fn():
+        return Dataset.from_arrays(data).shuffle(2 * batch + 1, seed=seed).repeat(
+            epochs
+        ).batch(batch, drop_remainder=True)
+
+    return fn
+
+
+def test_train_reduces_loss_and_counts_micro_steps(rng, tmp_path):
+    est = Estimator(
+        _linear_bundle(),
+        adam(5e-2),
+        GradAccumConfig(num_micro_batches=K, first_step_quirk=False),
+        RunConfig(model_dir=str(tmp_path), log_step_count_steps=50,
+                  save_checkpoints_steps=40),
+        mode="streaming",
+    )
+    state = est.train(_input_fn(rng, 256, B), max_steps=100)
+    assert int(state.step) == 100  # micro-batch semantics
+    results = est.evaluate(_input_fn(rng, 128, 64, epochs=1), state=state)
+    assert results["rmse"] < 0.5
+    assert (tmp_path / "loss_vs_step.csv").exists()
+    steps = [s for s, _ in all_checkpoints(str(tmp_path))]
+    assert 40 in steps and 80 in steps and 100 in steps
+
+
+def test_scan_mode_step_advances_by_k(rng):
+    est = Estimator(
+        _linear_bundle(),
+        adam(5e-2),
+        GradAccumConfig(num_micro_batches=K),
+        RunConfig(model_dir=None),
+        mode="scan",
+    )
+    # scan mode consumes [K*B] host batches
+    state = est.train(_input_fn(rng, 256, K * B), max_steps=60)
+    assert int(state.step) == 60
+
+
+def test_checkpoint_resume_mid_accumulation_exact(rng, tmp_path):
+    """Stop mid-accumulation-cycle; resumed run must match an uninterrupted
+    one bit-for-bit (the reference checkpoints accumulators too, SURVEY §5)."""
+    data_fn = _input_fn(rng, 64, B, seed=5)
+    cfg = GradAccumConfig(num_micro_batches=4, first_step_quirk=True)
+
+    def fresh(model_dir):
+        return Estimator(
+            _linear_bundle(),
+            sgd(0.05),
+            cfg,
+            RunConfig(model_dir=model_dir, save_checkpoints_steps=None),
+            mode="streaming",
+        )
+
+    # uninterrupted: 10 micro-steps (applies at 0, 4, 8; accum state live at 10)
+    est_a = fresh(str(tmp_path / "a"))
+    state_a = est_a.train(data_fn(), max_steps=10)
+
+    # interrupted at step 6 (mid-cycle), then resumed from checkpoint
+    est_b1 = fresh(str(tmp_path / "b"))
+    est_b1.train(data_fn(), max_steps=6)
+    est_b2 = fresh(str(tmp_path / "b"))  # new instance: must restore from disk
+    # feed the SAME stream position: skip the 6 batches already consumed
+    it = iter(data_fn())
+    for _ in range(6):
+        next(it)
+    state_b = est_b2.train(it, max_steps=10)
+
+    assert int(state_b.step) == 10
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        jax.device_get(state_a),
+        jax.device_get(state_b),
+    )
+
+
+def test_predict_yields_per_example(rng):
+    est = Estimator(
+        _linear_bundle(),
+        adam(1e-2),
+        GradAccumConfig(num_micro_batches=1),
+        RunConfig(),
+        mode="streaming",
+    )
+    est.train(_input_fn(rng, 64, B), max_steps=10)
+    pred_data = _regression_data(rng, 5)
+    preds = list(est.predict(lambda: Dataset.from_arrays(pred_data).batch(2)))
+    assert len(preds) == 5  # 2+2+1 over uneven batches
+    assert all(p["predictions"].shape == (1,) for p in preds)
+
+
+def test_train_and_evaluate_final_eval(rng, tmp_path):
+    est = Estimator(
+        _linear_bundle(),
+        adam(5e-2),
+        GradAccumConfig(num_micro_batches=K, first_step_quirk=False),
+        RunConfig(model_dir=str(tmp_path), log_step_count_steps=20),
+        mode="streaming",
+    )
+    state, results = est.train_and_evaluate(
+        TrainSpec(_input_fn(rng, 256, B), max_steps=120),
+        EvalSpec(_input_fn(rng, 128, 64, epochs=1), throttle_secs=0),
+    )
+    assert int(state.step) == 120
+    assert "rmse" in results and results["rmse"] < 0.5
+
+
+def test_accuracy_metric_streaming_uneven_batches():
+    m = accuracy(pred_key="classes", label_key="label")
+    out1 = {"classes": jnp.asarray([1, 2, 3])}
+    b1 = {"label": jnp.asarray([1, 2, 0])}
+    out2 = {"classes": jnp.asarray([5])}
+    b2 = {"label": jnp.asarray([5])}
+    t1, c1 = m.update(out1, b1)
+    t2, c2 = m.update(out2, b2)
+    assert float(m.finalize(t1 + t2, c1 + c2)) == 0.75
+
+
+def test_add_metrics_overlay():
+    base = {"mae": mean_absolute_error()}
+    out = add_metrics(base, {"rmse": root_mean_squared_error()})
+    assert set(out) == {"mae", "rmse"}
+    assert "rmse" not in base
+
+
+def test_checkpoint_keep_and_atomicity(tmp_path, rng):
+    state = {"w": np.arange(6, dtype=np.float32).reshape(2, 3), "step": np.int32(7)}
+    for s in [10, 20, 30, 40]:
+        save(str(tmp_path), state, s, keep=2)
+    assert [s for s, _ in all_checkpoints(str(tmp_path))] == [30, 40]
+    got = restore(str(tmp_path), state)
+    np.testing.assert_array_equal(got["w"], state["w"])
+    assert not list(tmp_path.glob("*.tmp"))
