@@ -1,0 +1,80 @@
+"""Benchmark: BERT-Small fine-tune throughput at effective batch 32 (8 x 4).
+
+The reference's headline configuration (README.md:60-78): BERT-Small
+L-4 H-512 A-8, seq 128, per-device micro-batch 8, K=4 gradient accumulation.
+North-star from BASELINE.json: >= 1,000 seq/s on TPU.
+
+Measures the full scan-mode train step (forward + backward + AdamW with
+warmup/decay schedule + clip-after-average) in bfloat16 on whatever device
+JAX provides, and prints ONE JSON line.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.models.bert import BertConfig, bert_classifier_bundle
+    from gradaccum_tpu.ops.accumulation import scan_init
+
+    K, MICRO, SEQ = 4, 8, 128
+    VOCAB = 30522
+
+    cfg = BertConfig.small(vocab_size=VOCAB, dtype=jnp.bfloat16)
+    bundle = bert_classifier_bundle(cfg, num_classes=2)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": rng.integers(0, VOCAB, size=(K * MICRO, SEQ)).astype(np.int32),
+        "input_mask": np.ones((K * MICRO, SEQ), np.int32),
+        "segment_ids": np.zeros((K * MICRO, SEQ), np.int32),
+        "label": rng.integers(0, 2, size=(K * MICRO,)).astype(np.int32),
+    }
+    sample = jax.tree.map(lambda x: x[:MICRO], batch)
+    params = bundle.init(jax.random.PRNGKey(0), sample)
+
+    schedule = gt.warmup_polynomial_decay(2e-5, num_train_steps=10000,
+                                          num_warmup_steps=1000)
+    opt = gt.ops.adamw(schedule, weight_decay_rate=0.01)
+    state = scan_init(params, opt)
+    step = jax.jit(
+        gt.accumulate_scan(
+            bundle.loss,
+            opt,
+            gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0),
+            needs_rng=True,
+        ),
+        donate_argnums=0,
+    )
+    stacked = gt.stack_micro_batches(batch, K)
+    key = jax.random.PRNGKey(1)
+
+    # compile + warmup
+    for _ in range(3):
+        state, aux = step(state, stacked, key)
+    jax.block_until_ready(aux["loss"])
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, aux = step(state, stacked, key)
+    jax.block_until_ready(aux["loss"])
+    dt = time.perf_counter() - t0
+
+    seqs_per_sec = iters * K * MICRO / dt
+    print(json.dumps({
+        "metric": "bert_small_seq128_effbatch32_train_throughput",
+        "value": round(seqs_per_sec, 2),
+        "unit": "seq/s",
+        "vs_baseline": round(seqs_per_sec / 1000.0, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

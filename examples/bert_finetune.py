@@ -1,0 +1,151 @@
+"""BERT-Small fine-tuning — the README's flagship experiment.
+
+Reference runs (README.md:60-78): BERT-Small uncased L-4 H-512 A-8, CoLA
+grammaticality task at per-device batch 8 × K=4 accumulation (effective 32,
+the workaround for the 4 GB GTX1050Ti), lr 2e-5, max_seq_length 128, and a
+Yelp-polarity 3-epoch run (554,400 train examples → 207,900 steps,
+README.md:75). AdamW with linear warmup + polynomial decay and clip-after-
+average, per optimization.py.
+
+Without the real datasets (zero-egress container) a deterministic synthetic
+sentence-classification corpus with CoLA/Yelp shapes is generated; pass
+--data-dir with {train,dev}.tsv to use real data.
+
+Usage: python examples/bert_finetune.py --task cola [--full]
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from examples.common import example_argparser, prepare_model_dir
+
+TASKS = {
+    # per-device micro-batch, K, default synthetic corpus size
+    "cola": dict(batch=8, k=4, num_train=2048, num_eval=512),
+    "yelp": dict(batch=8, k=4, num_train=8192, num_eval=1024),
+}
+
+
+def synthetic_text_task(num_examples: int, seed: int):
+    """Label-correlated synthetic sentences (zero-egress CoLA stand-in)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    good = ["the cat sat on the mat", "a dog runs fast", "birds fly high",
+            "she reads a good book", "the sun rises early"]
+    bad = ["mat the on sat cat the", "fast runs dog a", "high fly birds",
+           "book good a reads she", "early rises sun the"]
+    texts, labels = [], []
+    for _ in range(num_examples):
+        label = int(rng.integers(0, 2))
+        pool = good if label else bad
+        texts.append(" ".join(rng.choice(pool, size=int(rng.integers(1, 4)))))
+        labels.append(label)
+    return texts, np.asarray(labels, np.int32)
+
+
+def load_tsv(path):
+    import numpy as np
+
+    texts, labels = [], []
+    with open(path) as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) >= 2:
+                labels.append(int(parts[0]))
+                texts.append(parts[-1])
+    return texts, np.asarray(labels, np.int32)
+
+
+def main(argv=None):
+    parser = example_argparser("BERT-Small fine-tune (CoLA/Yelp shapes)",
+                               default_steps=400)
+    parser.add_argument("--task", choices=sorted(TASKS), default="cola")
+    parser.add_argument("--lr", type=float, default=2e-5)  # README.md:72
+    parser.add_argument("--seq-len", type=int, default=128)  # README.md:72
+    parser.add_argument("--warmup-frac", type=float, default=0.1)
+    parser.add_argument("--vocab", default=None, help="vocab.txt (else built from corpus)")
+    parser.add_argument("--bf16", action="store_true", help="bfloat16 MXU compute")
+    parser.add_argument("--full", action="store_true",
+                        help="reference scale: 3 epochs over the corpus")
+    args = parser.parse_args(argv)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.data.tokenization import build_vocab, load_vocab
+    from gradaccum_tpu.models.bert import BertConfig, bert_classifier_bundle
+
+    t = TASKS[args.task]
+    model_dir = prepare_model_dir(args, f"bert_{args.task}")
+
+    if args.data_dir:
+        train_texts, train_labels = load_tsv(f"{args.data_dir}/train.tsv")
+        eval_texts, eval_labels = load_tsv(f"{args.data_dir}/dev.tsv")
+    else:
+        train_texts, train_labels = synthetic_text_task(t["num_train"], seed=1)
+        eval_texts, eval_labels = synthetic_text_task(t["num_eval"], seed=2)
+
+    tok = load_vocab(args.vocab) if args.vocab else build_vocab(train_texts)
+    train = dict(
+        tok.encode_batch(train_texts, max_seq_length=args.seq_len),
+        label=train_labels,
+    )
+    evald = dict(
+        tok.encode_batch(eval_texts, max_seq_length=args.seq_len),
+        label=eval_labels,
+    )
+
+    micro = t["batch"]
+    k = t["k"]
+    if args.full:
+        # 3 epochs in micro-batch steps (README.md:75's formula)
+        max_steps = len(train_labels) * 3 // micro
+    else:
+        max_steps = args.max_steps
+
+    cfg = BertConfig.small(
+        vocab_size=max(len(tok.vocab), 128),
+        dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+    )
+    schedule = gt.warmup_polynomial_decay(
+        args.lr, num_train_steps=max_steps,
+        num_warmup_steps=int(max_steps * args.warmup_frac),
+    )
+    est = gt.Estimator(
+        bert_classifier_bundle(cfg, num_classes=2),
+        gt.ops.adamw(schedule, weight_decay_rate=0.01),  # optimization.py:59-65
+        gt.GradAccumConfig(num_micro_batches=k, clip_norm=1.0,
+                           first_step_quirk=True),  # optimization.py:76-94
+        gt.RunConfig(model_dir=model_dir, log_step_count_steps=max(max_steps // 20, 1)),
+        mode=args.mode,
+    )
+
+    host_batch = micro * (k if args.mode == "scan" else 1)
+
+    def train_fn():
+        return (
+            gt.Dataset.from_arrays(train)
+            .shuffle(2 * micro + 1, seed=19830610)
+            .repeat()
+            .batch(host_batch, drop_remainder=True)
+            .prefetch(2)
+        )
+
+    def eval_fn():
+        return gt.Dataset.from_arrays(evald).batch(64)
+
+    state, results = est.train_and_evaluate(
+        gt.TrainSpec(train_fn, max_steps=max_steps),
+        gt.EvalSpec(eval_fn, throttle_secs=60),
+    )
+    print(f"{args.task}: eval accuracy {results['accuracy']:.4f} "
+          f"(effective batch {micro * k}, loss CSV in {model_dir})")
+    return results
+
+
+if __name__ == "__main__":
+    main()

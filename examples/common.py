@@ -1,0 +1,37 @@
+"""Shared plumbing for the example entrypoints.
+
+Each example mirrors one of the reference's runnable configurations
+(BASELINE.json / SURVEY.md §6) and prints a loss-vs-step CSV into its
+model_dir — the data behind the reference's Loss_Step*.png comparisons.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+
+
+def example_argparser(description: str, default_steps: int) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--model-dir", default=None, help="checkpoint/log dir")
+    p.add_argument(
+        "--max-steps", type=int, default=default_steps,
+        help="micro-batch steps (reference global_step semantics)",
+    )
+    p.add_argument("--data-dir", default=None, help="real dataset directory (else synthetic)")
+    p.add_argument(
+        "--resume", action="store_true",
+        help="keep model_dir (the reference's RESUME_TRAINING, another-example.py:209)",
+    )
+    p.add_argument("--mode", choices=["scan", "streaming"], default="scan")
+    return p
+
+
+def prepare_model_dir(args, default_name: str) -> str:
+    model_dir = args.model_dir or os.path.join("/tmp/gradaccum_runs", default_name)
+    if not args.resume and os.path.isdir(model_dir):
+        # 01/02 semantics: always start fresh (01:69-70) unless resuming
+        shutil.rmtree(model_dir)
+    os.makedirs(model_dir, exist_ok=True)
+    return model_dir

@@ -1,0 +1,78 @@
+"""Housing regression — the reference's another-example.py experiment.
+
+Config per another-example.py:267-277: batch 59, K=3 accumulation, MLP
+hidden [16, 8, 4], seed 19830610, MSE loss with MAE/RMSE eval metrics,
+70/30 train/test split. The reference's plain AdamOptimizer drives it
+(another-example.py:138); train ends with evaluate-on-train, evaluate-on-
+test, and a 5-example predict (another-example.py:361-389).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from examples.common import example_argparser, prepare_model_dir
+
+
+def main(argv=None):
+    parser = example_argparser("Housing regression with K=3 accumulation",
+                               default_steps=3000)
+    parser.add_argument("--batch", type=int, default=59)
+    parser.add_argument("--k", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    args = parser.parse_args(argv)
+
+    import numpy as np
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.data.csv import load_housing
+    from gradaccum_tpu.models.housing_mlp import housing_mlp_bundle
+
+    model_dir = prepare_model_dir(args, "housing")
+    X, y = load_housing(args.data_dir)
+    # 70/30 split with the reference's seed (another-example.py:244)
+    rng = np.random.default_rng(19830610)
+    perm = rng.permutation(len(X))
+    cut = int(0.7 * len(X))
+    tr, te = perm[:cut], perm[cut:]
+
+    est = gt.Estimator(
+        housing_mlp_bundle(),
+        gt.ops.adam(args.lr),
+        gt.GradAccumConfig(num_micro_batches=args.k, first_step_quirk=True),
+        gt.RunConfig(model_dir=model_dir, log_step_count_steps=1000),  # :284
+        mode=args.mode,
+    )
+
+    host_batch = args.batch * (args.k if args.mode == "scan" else 1)
+
+    def train_fn():
+        return (
+            gt.Dataset.from_arrays({"x": X[tr], "y": y[tr]})
+            .shuffle(2 * args.batch + 1, seed=19830610)  # another-example.py:44
+            .repeat()
+            .batch(host_batch, drop_remainder=True)
+        )
+
+    def eval_fn(split):
+        data = {"x": X[tr], "y": y[tr]} if split == "train" else {"x": X[te], "y": y[te]}
+        return lambda: gt.Dataset.from_arrays(data).batch(len(data["y"]))
+
+    state, _ = est.train_and_evaluate(
+        gt.TrainSpec(train_fn, max_steps=args.max_steps),
+        gt.EvalSpec(eval_fn("test"), throttle_secs=30),
+    )
+    train_res = est.evaluate(eval_fn("train"), state=state, name="final/train")
+    test_res = est.evaluate(eval_fn("test"), state=state, name="final/test")
+    print(f"Train RMSE: {train_res['rmse']:.4f}  Test RMSE: {test_res['rmse']:.4f}")
+    preds = list(est.predict(lambda: gt.Dataset.from_arrays(
+        {"x": X[te][:5], "y": y[te][:5]}).batch(5), state=state))
+    for i, p in enumerate(preds):  # predict 5 (another-example.py:385-389)
+        print(f"  predict[{i}] = {float(p['predictions'][0]):.3f} "
+              f"(label {float(y[te][i, 0]):.3f})")
+    return test_res
+
+
+if __name__ == "__main__":
+    main()

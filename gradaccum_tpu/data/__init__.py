@@ -8,3 +8,5 @@ from gradaccum_tpu.data.csv import (
 )
 from gradaccum_tpu.data.mnist import load as load_mnist
 from gradaccum_tpu.data.pipeline import Dataset
+from gradaccum_tpu.data import tokenization
+from gradaccum_tpu.data.tokenization import Tokenizer, build_vocab, load_vocab

@@ -74,6 +74,7 @@ class Estimator:
         self.mode = mode
         self._train_step = None
         self._eval_step = None
+        self._predict_fn = None
         self._state = None  # last trained/restored state
 
     # -- state ----------------------------------------------------------
@@ -153,11 +154,16 @@ class Estimator:
 
     # -- public API (Estimator parity) ------------------------------------
 
-    def train(self, input_fn, max_steps: Optional[int] = None, state=None):
+    def train(
+        self, input_fn, max_steps: Optional[int] = None, state=None,
+        final_save: bool = True,
+    ):
         """Train until ``max_steps`` micro-batches (or the input runs out).
 
-        Resumes from the newest checkpoint in ``model_dir`` when present —
-        including mid-accumulation-cycle accumulator state (SURVEY.md §5).
+        In scan mode, training stops at the last whole K-cycle that fits
+        (``state.step`` never exceeds ``max_steps``). Resumes from the newest
+        checkpoint in ``model_dir`` when present — including
+        mid-accumulation-cycle accumulator state (SURVEY.md §5).
         """
         cfg = self.config
         it = iter(input_fn() if callable(input_fn) else input_fn)
@@ -182,11 +188,26 @@ class Estimator:
         step_no = int(jax.device_get(state.step))
         steps_at_t0 = step_no
         last_logged_bucket = step_no // log_every
-        loss_rows = []  # (step, device scalar) — fetched lazily
+        loss_rows = []  # (step, device scalar) — fetched lazily at flushes
         micro_size = None
+        last_saved = None
+
+        def flush(save_ckpt: bool):
+            nonlocal last_saved
+            if not cfg.model_dir:
+                return
+            if save_ckpt and last_saved != step_no:
+                ckpt_lib.save(cfg.model_dir, state, step_no, cfg.keep_checkpoint_max)
+                last_saved = step_no
+            if loss_rows:
+                self._append_loss_csv(
+                    [(s, float(v)) for s, v in jax.device_get(loss_rows)]
+                )
+                loss_rows.clear()
 
         while True:
-            if max_steps is not None and step_no >= max_steps:
+            # scan mode consumes whole K-cycles: stop before overshooting
+            if max_steps is not None and step_no + k > max_steps:
                 break
             batch = pending if pending is not None else next(it, None)
             pending = None
@@ -196,7 +217,8 @@ class Estimator:
                 micro_size = self._micro_size(batch)
             state, aux = step_fn(state, *self._prep_batch(batch, step_no))
             step_no += k
-            loss_rows.append((step_no, aux["loss"]))
+            if cfg.model_dir:
+                loss_rows.append((step_no, aux["loss"]))
             bucket = step_no // log_every
             if bucket != last_logged_bucket:
                 dt = time.time() - t0
@@ -208,17 +230,12 @@ class Estimator:
                 )
                 last_logged_bucket = bucket
             if (
-                cfg.model_dir
-                and cfg.save_checkpoints_steps
+                cfg.save_checkpoints_steps
                 and step_no % cfg.save_checkpoints_steps < k
             ):
-                ckpt_lib.save(cfg.model_dir, state, step_no, cfg.keep_checkpoint_max)
+                flush(save_ckpt=True)
 
-        if cfg.model_dir:
-            ckpt_lib.save(cfg.model_dir, state, step_no, cfg.keep_checkpoint_max)
-            self._append_loss_csv(
-                [(s, float(v)) for s, v in jax.device_get(loss_rows)]
-            )
+        flush(save_ckpt=final_save)
         self._state = state
         return state
 
@@ -274,7 +291,9 @@ class Estimator:
         if first is None:
             return
         params = self._params_for_inference(first, state, checkpoint_path)
-        predict = jax.jit(self.model.predict)
+        if self._predict_fn is None:
+            self._predict_fn = jax.jit(self.model.predict)
+        predict = self._predict_fn
         batch = first
         while batch is not None:
             outputs = jax.device_get(predict(params, batch))
@@ -299,6 +318,7 @@ class Estimator:
             state = self.train(
                 itertools.islice(it, max(chunk // k, 1)),
                 max_steps=train_spec.max_steps,
+                final_save=False,  # periodic cadence only; final save below
             )
             done_steps = int(jax.device_get(state.step))
             peeked = next(it, None)
@@ -308,6 +328,11 @@ class Estimator:
                 train_spec.max_steps is not None
                 and done_steps >= train_spec.max_steps
             ) or peeked is None:
+                if self.config.model_dir:
+                    ckpt_lib.save(
+                        self.config.model_dir, state, done_steps,
+                        self.config.keep_checkpoint_max,
+                    )
                 results = self.evaluate(
                     eval_spec.input_fn, steps=eval_spec.steps, state=state,
                     name=eval_spec.name,

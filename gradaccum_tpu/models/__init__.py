@@ -1,0 +1,10 @@
+from gradaccum_tpu.models import bert, housing_mlp, mnist_cnn
+from gradaccum_tpu.models.bert import (
+    BertClassifier,
+    BertConfig,
+    BertEncoder,
+    bert_classifier_bundle,
+    dense_attention,
+)
+from gradaccum_tpu.models.housing_mlp import HousingMLP, housing_mlp_bundle
+from gradaccum_tpu.models.mnist_cnn import MnistCNN, mnist_cnn_bundle

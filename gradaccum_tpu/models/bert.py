@@ -1,0 +1,255 @@
+"""BERT encoder + classification head, TPU-first.
+
+The reference fine-tunes google-research/bert's TF1 model — BERT-Small
+uncased L-4 H-512 A-8 (/root/reference/README.md:67) at max_seq_length 128
+(README.md:72) — and only contributes the optimizer (optimization.py). The
+model itself is therefore rebuilt here from the published architecture:
+post-LayerNorm transformer encoder, gelu FFN at 4×hidden, learned position
+embeddings, tanh pooler over [CLS], and a dropout classifier head (the
+``run_classifier.py`` head the README drives).
+
+TPU-first choices:
+
+- ``dtype=bfloat16`` compute path (params stay float32; matmuls and
+  attention run in bf16 on the MXU, logits/loss in f32).
+- attention is one ``einsum`` pipeline with a swappable core
+  (``attention_fn``) so sequence-parallel ring attention
+  (``parallel/ring_attention.py``) can replace the dense core without
+  touching the model.
+- optional per-layer ``jax.checkpoint`` (rematerialization) to trade
+  recompute for HBM at long sequence lengths.
+- LayerNorm submodules are literally named "LayerNorm" so the optimizer's
+  decay-exclusion regex (optimization.py:59-65) applies to the same
+  parameter set as in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from gradaccum_tpu.estimator.estimator import ModelBundle
+from gradaccum_tpu.estimator.metrics import accuracy
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 512  # H (README.md:67)
+    num_layers: int = 4  # L
+    num_heads: int = 8  # A
+    intermediate_size: int = 2048  # 4H, BERT convention
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.float32
+    remat: bool = False  # jax.checkpoint each encoder layer
+
+    @staticmethod
+    def small(**kw) -> "BertConfig":
+        return BertConfig(**kw)
+
+    @staticmethod
+    def tiny_for_tests(**kw) -> "BertConfig":
+        return BertConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+            intermediate_size=64, max_position_embeddings=64, **kw
+        )
+
+
+def dense_attention(q, k, v, mask, dropout_fn=None):
+    """Default attention core: full [B,Hd,S,S] scores on the MXU.
+
+    ``q,k,v``: [B, heads, S, head_dim]; ``mask``: [B, 1, 1, S] additive.
+    Swappable: ring attention provides the same signature, sharded over the
+    ``seq`` mesh axis.
+    """
+    depth = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(depth, q.dtype)
+    )
+    if mask is not None:
+        scores = scores + mask
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_fn is not None:
+        probs = dropout_fn(probs)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+class SelfAttention(nn.Module):
+    config: BertConfig
+    attention_fn: Callable = dense_attention
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+
+        def split_heads(t):
+            return t.reshape(t.shape[0], t.shape[1], cfg.num_heads, head_dim).transpose(
+                0, 2, 1, 3
+            )
+
+        q = split_heads(nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="query")(x))
+        k = split_heads(nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="key")(x))
+        v = split_heads(nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="value")(x))
+
+        dropout_fn = None
+        if cfg.attention_dropout > 0 and not deterministic:
+            dropout = nn.Dropout(cfg.attention_dropout, name="attn_dropout")
+            dropout_fn = lambda p: dropout(p, deterministic=False)
+
+        ctx = self.attention_fn(q, k, v, mask, dropout_fn)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], cfg.hidden_size)
+        return nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="output")(ctx)
+
+
+class EncoderLayer(nn.Module):
+    config: BertConfig
+    attention_fn: Callable = dense_attention
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.config
+        attn_out = SelfAttention(cfg, self.attention_fn, name="attention")(
+            x, mask, deterministic
+        )
+        attn_out = nn.Dropout(cfg.hidden_dropout)(attn_out, deterministic=deterministic)
+        # post-LN (original BERT): LN(x + sublayer(x))
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="attention_LayerNorm")(x + attn_out)
+        ffn = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="intermediate")(x)
+        ffn = nn.gelu(ffn, approximate=False)
+        ffn = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="ffn_output")(ffn)
+        ffn = nn.Dropout(cfg.hidden_dropout)(ffn, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                            name="output_LayerNorm")(x + ffn)
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig
+    attention_fn: Callable = dense_attention
+
+    @nn.compact
+    def __call__(self, input_ids, input_mask=None, segment_ids=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        B, S = input_ids.shape
+        if input_mask is None:
+            input_mask = jnp.ones((B, S), jnp.int32)
+        if segment_ids is None:
+            segment_ids = jnp.zeros((B, S), jnp.int32)
+
+        word = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                        name="word_embeddings")(input_ids)
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                       dtype=cfg.dtype, name="position_embeddings")(
+            jnp.arange(S)[None, :]
+        )
+        typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       name="token_type_embeddings")(segment_ids)
+        x = word + pos + typ
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="embeddings_LayerNorm")(x)
+        x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+
+        # additive mask: 0 where attended, -1e9 where padded
+        mask = (1.0 - input_mask[:, None, None, :].astype(jnp.float32)) * -1e9
+        mask = mask.astype(cfg.dtype)
+
+        layer_cls = EncoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, self.attention_fn, name=f"layer_{i}")(
+                x, mask, deterministic
+            )
+        return x
+
+
+class BertClassifier(nn.Module):
+    """Encoder + tanh pooler + dropout classifier (run_classifier.py's head)."""
+
+    config: BertConfig
+    num_classes: int = 2
+    attention_fn: Callable = dense_attention
+
+    @nn.compact
+    def __call__(self, input_ids, input_mask=None, segment_ids=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        seq = BertEncoder(cfg, self.attention_fn, name="bert")(
+            input_ids, input_mask, segment_ids, deterministic
+        )
+        cls = seq[:, 0]  # [CLS]
+        pooled = jnp.tanh(
+            nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="pooler")(cls)
+        )
+        pooled = nn.Dropout(cfg.hidden_dropout)(pooled, deterministic=deterministic)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="classifier")(
+            pooled.astype(jnp.float32)
+        )
+        return logits
+
+
+def bert_classifier_bundle(
+    config: BertConfig,
+    num_classes: int = 2,
+    attention_fn: Callable = dense_attention,
+) -> ModelBundle:
+    """ModelBundle for CoLA/Yelp-style sequence classification.
+
+    Batches: ``{"input_ids": [B,S] int32, "input_mask": [B,S] int32,
+    "segment_ids": [B,S] int32, "label": [B] int32}`` (+ harness-injected
+    ``"rng"`` for dropout — ``needs_rng=True``).
+    """
+    model = BertClassifier(config, num_classes, attention_fn)
+
+    def init(rng, sample):
+        return model.init(
+            {"params": rng, "dropout": rng},
+            sample["input_ids"],
+            sample.get("input_mask"),
+            sample.get("segment_ids"),
+            True,
+        )
+
+    def loss(params, batch):
+        logits = model.apply(
+            params,
+            batch["input_ids"],
+            batch.get("input_mask"),
+            batch.get("segment_ids"),
+            False,
+            rngs={"dropout": batch["rng"]},
+        )
+        onehot = jax.nn.one_hot(batch["label"], num_classes)
+        return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+    def predict(params, batch):
+        logits = model.apply(
+            params,
+            batch["input_ids"],
+            batch.get("input_mask"),
+            batch.get("segment_ids"),
+            True,
+        )
+        return {
+            "logits": logits,
+            "classes": jnp.argmax(logits, axis=-1),
+            "probabilities": jax.nn.softmax(logits),
+        }
+
+    return ModelBundle(
+        init=init,
+        loss=loss,
+        predict=predict,
+        eval_metrics={"accuracy": accuracy()},
+        needs_rng=True,
+    )

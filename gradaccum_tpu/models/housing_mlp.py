@@ -1,0 +1,58 @@
+"""Housing-price regression MLP.
+
+The reference's Keras functional MLP (/root/reference/another-example.py:
+109-118): Dense hidden stack [16, 8, 4] with relu → Dense(1), on the
+feature-column input layer (another-example.py:99-102), trained under a
+canned ``regression_head`` (MSE loss) with MAE/RMSE attached via
+``add_metrics`` (another-example.py:172-181).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from gradaccum_tpu.estimator.estimator import ModelBundle
+from gradaccum_tpu.estimator.metrics import (
+    mean_absolute_error,
+    root_mean_squared_error,
+)
+
+
+class HousingMLP(nn.Module):
+    hidden: Sequence[int] = (16, 8, 4)  # another-example.py:275 (hidden_units)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, features):
+        x = features.astype(self.dtype)
+        for i, width in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(width, dtype=self.dtype, name=f"hidden_{i}")(x))
+        return nn.Dense(1, dtype=self.dtype, name="output")(x).astype(jnp.float32)
+
+
+def housing_mlp_bundle(hidden: Sequence[int] = (16, 8, 4)) -> ModelBundle:
+    """Batches: ``{"x": [B, 14] float32, "y": [B, 1] float32}``."""
+    model = HousingMLP(hidden=tuple(hidden))
+
+    def init(rng, sample):
+        return model.init(rng, sample["x"])
+
+    def loss(params, batch):
+        pred = model.apply(params, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)  # regression_head MSE
+
+    def predict(params, batch):
+        return {"predictions": model.apply(params, batch["x"])}
+
+    return ModelBundle(
+        init=init,
+        loss=loss,
+        predict=predict,
+        eval_metrics={
+            "mae": mean_absolute_error(label_key="y"),
+            "rmse": root_mean_squared_error(label_key="y"),
+        },
+    )

@@ -1,0 +1,72 @@
+"""MNIST CNN — the reference's Keras Sequential model as a flax module.
+
+Architecture per /root/reference/distributedExample/01:22-28 (identical in
+02/03/04): Conv2D(32, 3×3, relu) → MaxPool(2×2) → Flatten → Dense(64, relu)
+→ Dense(10 logits). Loss = sparse softmax cross-entropy summed then scaled
+by 1/batch (01:43-45, i.e. the mean). Predictions dict carries logits,
+argmax classes, and softmax probabilities (02:31-33).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from gradaccum_tpu.estimator.estimator import ModelBundle
+from gradaccum_tpu.estimator.metrics import accuracy
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, images):
+        x = images.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype, name="conv")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(64, dtype=self.dtype, name="dense")(x))
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
+        return logits.astype(jnp.float32)
+
+
+def sparse_softmax_loss(logits, labels):
+    """Σ SparseCE · (1/B) — 01:43-45's reduction=NONE then reduce_sum/B."""
+    onehot = jax.nn.one_hot(labels, logits.shape[-1])
+    per_example = -jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1)
+    return jnp.sum(per_example) * (1.0 / labels.shape[0])
+
+
+def mnist_cnn_bundle(dtype=jnp.float32) -> ModelBundle:
+    """ModelBundle for the MNIST model_fn (01:20-65).
+
+    Batches: ``{"image": [B,28,28,1] float32, "label": [B] int}``.
+    """
+    model = MnistCNN(dtype=dtype)
+
+    def init(rng, sample):
+        return model.init(rng, sample["image"])
+
+    def loss(params, batch):
+        logits = model.apply(params, batch["image"])
+        return sparse_softmax_loss(logits, batch["label"])
+
+    def predict(params, batch) -> Dict[str, Any]:
+        logits = model.apply(params, batch["image"])
+        return {
+            "logits": logits,
+            "classes": jnp.argmax(logits, axis=-1),
+            "probabilities": jax.nn.softmax(logits),
+        }
+
+    return ModelBundle(
+        init=init,
+        loss=loss,
+        predict=predict,
+        eval_metrics={"accuracy": accuracy()},  # 02:75-76
+    )

@@ -1,0 +1,33 @@
+"""Tensor-parallel sharding rules (Megatron-style) for the BERT encoder.
+
+The reference has no tensor parallelism (SURVEY.md §2 checklist) — this is a
+TPU-native extension: first-match regex rules mapping parameter names to
+PartitionSpecs over the ``model`` mesh axis, consumed by
+``parallel.sharding.shard_params`` / GSPMD propagation. Column-parallel
+QKV/intermediate projections, row-parallel output projections; XLA inserts
+the reduce-scatter/all-reduce pair on the row-parallel matmuls.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from gradaccum_tpu.parallel.mesh import MODEL_AXIS
+
+
+def bert_tp_rules(axis: str = MODEL_AXIS):
+    """Rules for models/bert.py parameter names (apply to the whole
+    TrainState: optimizer moments and accumulators share the params' tree
+    structure, so the same regexes shard them identically)."""
+    return [
+        # column-parallel: shard the output features
+        (r"(query|key|value)/kernel", P(None, axis)),
+        (r"(query|key|value)/bias", P(axis)),
+        (r"intermediate/kernel", P(None, axis)),
+        (r"intermediate/bias", P(axis)),
+        # row-parallel: shard the input features; outputs all-reduce
+        (r"attention/output/kernel", P(axis, None)),
+        (r"ffn_output/kernel", P(axis, None)),
+        # big embedding table: shard the vocab dim
+        (r"word_embeddings/embedding", P(axis, None)),
+    ]
