@@ -313,6 +313,12 @@ class Estimator:
         it = iter(train_spec.input_fn())
         k = self.accum.num_micro_batches if self.mode == "scan" else 1
         chunk = max(self.config.log_step_count_steps, k)
+        # scan mode consumes whole K-cycles, so state.step can never exceed
+        # the last multiple of K below max_steps — terminate there, not at
+        # the raw max_steps (which an off-multiple value would never reach)
+        reachable_max = None
+        if train_spec.max_steps is not None:
+            reachable_max = (train_spec.max_steps // k) * k
 
         while True:
             state = self.train(
@@ -325,8 +331,7 @@ class Estimator:
             if peeked is not None:
                 it = itertools.chain([peeked], it)
             if (
-                train_spec.max_steps is not None
-                and done_steps >= train_spec.max_steps
+                reachable_max is not None and done_steps >= reachable_max
             ) or peeked is None:
                 if self.config.model_dir:
                     ckpt_lib.save(

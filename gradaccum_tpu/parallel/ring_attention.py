@@ -1,0 +1,182 @@
+"""Sequence/context parallelism: ring attention over the ``seq`` mesh axis.
+
+The reference has no long-context support at all — sequence length is a fixed
+preprocessing constant (``--max_seq_length=128``, /root/reference/README.md:72)
+and its only scaling axes are micro-batch serialization and worker data
+parallelism (README.md:126, 137-139). This module is the TPU-native extension
+that makes sequence length a *mesh axis*: activations are sharded ``[B, H,
+S/n, D]`` along ``seq``, each device computes attention for its local query
+block, and key/value blocks rotate around the ring via ``lax.ppermute`` —
+n-1 hops over ICI, each overlapped with the block matmuls, never
+materializing the full ``[S, S]`` score matrix anywhere.
+
+Both cores use the same numerically-stable **online softmax** accumulation as
+flash attention: carry a running row-max ``m``, normalizer ``l``, and
+unnormalized output ``o``; each new key block rescales the carry by
+``exp(m - m_new)``. Stats are kept in float32 while the block matmuls stay in
+the compute dtype (bf16 on the MXU).
+
+Three entry points, all signature-compatible with
+``models.bert.dense_attention`` (``(q, k, v, mask, dropout_fn) -> ctx`` with
+``q,k,v: [B, heads, S, head_dim]`` and additive key mask ``[B, 1, 1, S]``):
+
+- :func:`blockwise_attention` — single-device memory-efficient core:
+  ``lax.scan`` over key/value blocks. O(S) memory in sequence length; the
+  long-context story on one chip.
+- :func:`ring_attention` — the same loop distributed: must run inside
+  ``shard_map`` with the sequence dimension sharded over ``axis``.
+- :func:`make_ring_attention_fn` — binds the axis name so the result drops
+  into ``BertEncoder(attention_fn=...)`` when the whole train step is
+  shard_mapped with a ``seq`` axis.
+
+Attention-probability dropout is not supported in these cores (the probs are
+never materialized post-normalization); pass ``attention_dropout=0.0`` —
+standard practice for long-context training. ``dropout_fn`` is accepted for
+signature parity and rejected if non-None.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gradaccum_tpu.parallel.mesh import SEQ_AXIS
+
+_NEG_INF = -1e30  # finite stand-in for -inf: keeps exp/corrections NaN-free
+
+
+def _online_block(carry, q, k_blk, v_blk, mask_blk, scale):
+    """Fold one key/value block into the (o, m, l) online-softmax carry.
+
+    ``o``: [B,H,Sq,D] float32 unnormalized output; ``m``/``l``: [B,H,Sq,1]
+    float32 running max / normalizer. Matmuls run in the inputs' dtype (bf16
+    on the MXU); stats and the rescale in float32.
+    """
+    o, m, l = carry
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+    scores = scores.astype(jnp.float32)
+    if mask_blk is not None:
+        scores = scores + mask_blk.astype(jnp.float32)
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new)
+    l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk)
+    o = o * correction + pv.astype(jnp.float32)
+    return o, m_new, l
+
+
+def _init_carry(q):
+    b, h, s, d = q.shape
+    return (
+        jnp.zeros((b, h, s, d), jnp.float32),
+        jnp.full((b, h, s, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h, s, 1), jnp.float32),
+    )
+
+
+def _check_no_dropout(dropout_fn, name):
+    if dropout_fn is not None:
+        raise NotImplementedError(
+            f"{name} does not materialize attention probabilities, so "
+            "probability dropout cannot be applied; set attention_dropout=0.0"
+        )
+
+
+def blockwise_attention(q, k, v, mask=None, dropout_fn=None, *, block_size: int = 512):
+    """Memory-efficient single-device attention: scan over key/value blocks.
+
+    Exact (up to float reassociation) equivalent of ``dense_attention`` with
+    O(S·block) peak memory instead of O(S²). ``block_size`` is clamped to S
+    and must divide it (pad upstream otherwise).
+    """
+    _check_no_dropout(dropout_fn, "blockwise_attention")
+    b, h, s, d = q.shape
+    block = min(block_size, s)
+    if s % block:
+        raise ValueError(f"seq len {s} not divisible by block_size {block}")
+    n_blocks = s // block
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(q.dtype)
+
+    if n_blocks == 1:
+        o, _, l = _online_block(_init_carry(q), q, k, v, mask, scale)
+        return (o / l).astype(q.dtype)
+
+    k_blocks = k.reshape(b, h, n_blocks, block, d).transpose(2, 0, 1, 3, 4)
+    v_blocks = v.reshape(b, h, n_blocks, block, d).transpose(2, 0, 1, 3, 4)
+    if mask is not None:
+        mask_blocks = mask.reshape(b, 1, 1, n_blocks, block).transpose(3, 0, 1, 2, 4)
+        xs = (k_blocks, v_blocks, mask_blocks)
+        body = lambda c, x: (_online_block(c, q, x[0], x[1], x[2], scale), None)
+    else:
+        xs = (k_blocks, v_blocks)
+        body = lambda c, x: (_online_block(c, q, x[0], x[1], None, scale), None)
+
+    (o, _, l), _ = lax.scan(body, _init_carry(q), xs)
+    return (o / l).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mask=None, dropout_fn=None, *, axis: str = SEQ_AXIS):
+    """Ring attention: sequence-sharded exact attention inside ``shard_map``.
+
+    Every rank holds the local blocks ``q,k,v: [B, H, S/n, D]`` and key mask
+    ``[B,1,1,S/n]``. Each of the n ring steps folds the currently-held k/v
+    block into the online-softmax carry, then rotates k/v (and mask) to the
+    next rank with ``lax.ppermute`` — the collective rides ICI neighbor
+    links and overlaps with the next block's matmuls. After n steps every
+    rank has attended its queries over the FULL sequence; output stays
+    sequence-sharded. No materialized [S,S] anywhere, no all-gather.
+    """
+    _check_no_dropout(dropout_fn, "ring_attention")
+    n = lax.axis_size(axis)
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(q.dtype)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def rotate(x):
+        return lax.ppermute(x, axis, perm)
+
+    def body(_, state):
+        o, m, l, k_blk, v_blk, mask_blk = state
+        o, m, l = _online_block((o, m, l), q, k_blk, v_blk, mask_blk, scale)
+        # rotate AFTER computing; XLA overlaps the permute with the next
+        # iteration's matmuls (None mask is an empty pytree — carries fine)
+        k_blk, v_blk = rotate(k_blk), rotate(v_blk)
+        if mask_blk is not None:
+            mask_blk = rotate(mask_blk)
+        return o, m, l, k_blk, v_blk, mask_blk
+
+    # n-1 [compute, rotate] hops in a compiled loop, then the last block's
+    # compute without the wasted final rotate. The zero-init stats are
+    # replica-invariant while the loop produces axis-varying values — pcast
+    # them so the fori_loop carry types line up.
+    init = jax.tree.map(
+        lambda x: lax.pcast(x, axis, to="varying"), _init_carry(q)
+    )
+    carry = init + (k, v, mask)
+    if n > 1:
+        carry = lax.fori_loop(0, n - 1, body, carry)
+    o, m, l, k_blk, v_blk, mask_blk = carry
+    o, m, l = _online_block((o, m, l), q, k_blk, v_blk, mask_blk, scale)
+    return (o / l).astype(q.dtype)
+
+
+def make_ring_attention_fn(axis: str = SEQ_AXIS):
+    """Bind the mesh axis: returns an ``attention_fn`` for ``BertEncoder``."""
+    return partial(ring_attention, axis=axis)
+
+
+def shard_seq_batch(batch, mesh, axis: str = SEQ_AXIS, seq_keys=("input_ids", "input_mask", "segment_ids")):
+    """Device_put a dict batch with its sequence dimension sharded over
+    ``axis`` (dim 1 of [B, S] features); other leaves replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(key, x):
+        spec = P(None, axis) if key in seq_keys else P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return {key: put(key, x) for key, x in batch.items()}

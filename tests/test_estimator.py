@@ -160,6 +160,24 @@ def test_train_and_evaluate_final_eval(rng, tmp_path):
     assert "rmse" in results and results["rmse"] < 0.5
 
 
+def test_train_and_evaluate_scan_max_steps_off_multiple(rng, tmp_path):
+    """Regression: scan mode + max_steps not a multiple of K + repeating data
+    must terminate at the last whole K-cycle, not loop forever."""
+    est = Estimator(
+        _linear_bundle(),
+        adam(5e-2),
+        GradAccumConfig(num_micro_batches=4),
+        RunConfig(model_dir=str(tmp_path), log_step_count_steps=20),
+        mode="scan",
+    )
+    state, results = est.train_and_evaluate(
+        TrainSpec(_input_fn(rng, 256, 4 * B), max_steps=30),  # 30 % 4 == 2
+        EvalSpec(_input_fn(rng, 128, 64, epochs=1), throttle_secs=3600),
+    )
+    assert int(state.step) == 28  # floor(30/4)*4
+    assert "rmse" in results
+
+
 def test_accuracy_metric_streaming_uneven_batches():
     m = accuracy(pred_key="classes", label_key="label")
     out1 = {"classes": jnp.asarray([1, 2, 3])}
