@@ -9,4 +9,5 @@ from gradaccum_tpu.ops.accumulation import (
 )
 from gradaccum_tpu.ops.adamw import Optimizer, adam, adamw, sgd
 from gradaccum_tpu.ops.clipping import clip_by_global_norm
+from gradaccum_tpu.ops.flash_attention import flash_attention
 from gradaccum_tpu.ops.schedule import polynomial_decay, warmup_polynomial_decay

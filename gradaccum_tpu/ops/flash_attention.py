@@ -1,0 +1,191 @@
+"""Fused flash attention as a Pallas TPU kernel.
+
+The hot op of the BERT fine-tune path (the reference's flagship workload,
+/root/reference/README.md:60-78, runs attention inside google-research/bert's
+TF graph — here it is a hand-scheduled TPU kernel). One ``pallas_call``
+computes softmax(qkᵀ/√d + mask)·v per (batch, head, q-block) without ever
+materializing the [S, S] score matrix in HBM: k/v stream through VMEM one
+block at a time while float32 online-softmax stats (running max ``m``,
+normalizer ``l``, unnormalized accumulator ``acc``) live in VMEM scratch
+across the k-block grid dimension (TPU grids iterate the last axis
+sequentially, so scratch carries).
+
+Backward runs through :func:`...parallel.ring_attention.blockwise_attention`
+via ``jax.custom_vjp`` — same math, O(S·block) memory, XLA-fused — so the
+kernel is a drop-in differentiable ``attention_fn`` for
+``models.bert.BertEncoder``. Attention-probability dropout is not supported
+(probs are never materialized); set ``attention_dropout=0.0``.
+
+On non-TPU backends the kernel runs in Pallas interpreter mode (the test
+path on the 8-device virtual CPU mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from gradaccum_tpu.parallel.ring_attention import blockwise_attention
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref, *, scale):
+    """Grid (B, H, num_q_blocks, num_k_blocks); refs are one block each.
+
+    Block shapes: q/o [1,1,bq,D], k/v [1,1,bk,D], mask [1,1,1,bk]; scratch
+    acc [bq,D], m/l [bq,1] — all float32, carried across the k dimension.
+    """
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0]  # [bq, D]
+    k = k_ref[0, 0]  # [bk, D]
+    v = v_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [bq, bk]
+    if mask_ref is not None:
+        s = s + mask_ref[0, 0].astype(jnp.float32)  # [1, bk] broadcasts
+
+    m_prev, l_prev = m_ref[:], l_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    correction = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_ref[:] = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[:] = acc_ref[:] * correction + pv
+    m_ref[:] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, mask, block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    bq, bk = min(block_q, s), min(block_k, s)
+    if s % bq or s % bk:
+        raise ValueError(f"seq len {s} not divisible by blocks ({bq}, {bk})")
+    if mask is not None and not interpret and bk < s and bk % 128:
+        # Mosaic requires partial blocks' lane dim to be 128-aligned; the
+        # mask block (1,1,1,bk) hits this when bk < S (q/k/v blocks cover
+        # their full last dim d, which is exempt)
+        raise ValueError(
+            f"on TPU with a mask, block_k must be a multiple of 128 or equal "
+            f"to the sequence length; got block_k={bk}, seq={s}"
+        )
+    grid = (b, h, s // bq, s // bk)
+    scale = 1.0 / (d ** 0.5)
+
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0))
+    o_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0))
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [q, k, v]
+    if mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 1, 1, bk), lambda b_, h_, iq, ik: (b_, 0, 0, ik))
+        )
+        operands.append(mask)
+        kernel = functools.partial(_fwd_kernel, scale=scale)
+    else:
+        kernel = functools.partial(
+            lambda qr, kr, vr, orf, a, m, l, *, scale: _fwd_kernel(
+                qr, kr, vr, None, orf, a, m, l, scale=scale
+            ),
+            scale=scale,
+        )
+
+    # b/h/q-block programs are independent; only the k-block axis carries
+    # scratch state — tell Mosaic so it can pipeline the independent dims
+    compiler_params = None
+    if not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        )
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=o_spec,
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(*operands)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, mask, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, mask, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, mask, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, mask, block_q, block_k, interpret), (q, k, v, mask)
+
+
+def _flash_bwd(block_q, block_k, interpret, residuals, g):
+    q, k, v, mask = residuals
+    # recompute-based backward through the XLA blockwise core: same online
+    # softmax, O(S·block) memory, exact gradients — including d(mask), so a
+    # learned additive bias (ALiBi/relative-position style) trains correctly
+    if mask is None:
+        f = lambda q_, k_, v_: blockwise_attention(q_, k_, v_, None, block_size=block_k)
+        _, vjp = jax.vjp(f, q, k, v)
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, None
+    f = lambda q_, k_, v_, m_: blockwise_attention(q_, k_, v_, m_, block_size=block_k)
+    _, vjp = jax.vjp(f, q, k, v, mask)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    mask=None,
+    dropout_fn=None,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Fused attention: drop-in for ``models.bert.dense_attention``.
+
+    ``q,k,v``: [B, heads, S, head_dim]; ``mask``: additive key mask
+    [B, 1, 1, S] or None. Differentiable (custom VJP). ``interpret=None``
+    auto-selects interpreter mode off-TPU.
+    """
+    if dropout_fn is not None:
+        raise NotImplementedError(
+            "flash_attention never materializes attention probabilities; "
+            "set attention_dropout=0.0"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, mask, block_q, block_k, interpret)
