@@ -34,7 +34,29 @@ def read_csv(
     """Read a CSV into a dict of column arrays (TextLineDataset + decode_csv
     semantics, another-example.py:40-47). Numeric columns parse to float32
     with default 0.0 for empty fields (the reference's record_defaults);
-    categorical columns stay strings."""
+    categorical columns stay strings.
+
+    Fully-numeric tables (no categorical columns) parse through the native
+    C++ runtime (native/dataloader.cc) when available; tables with
+    categorical columns always take the csv-module path, because a
+    through-float round trip of vocabulary strings silently remaps
+    empty/OOV/non-canonical values. Any native parse problem (ragged rows,
+    quoting) also falls back here.
+    """
+    if not any(c in HOUSING_CATEGORICAL for c in columns):
+        from gradaccum_tpu.data import native
+
+        try:
+            native_out = native.read_csv_numeric(path, skip_header)
+        except ValueError:
+            native_out = None  # ragged/quoted input: csv module handles it
+        if native_out is not None:
+            matrix, n_cols = native_out
+            if n_cols == len(columns):
+                return {
+                    name: matrix[:, i].copy() for i, name in enumerate(columns)
+                }
+
     rows: List[List[str]] = []
     with open(path, newline="") as f:
         reader = _csv.reader(f)

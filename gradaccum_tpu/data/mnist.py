@@ -44,8 +44,17 @@ def read_images(path: str) -> np.ndarray:
     """Parse an idx3 image file → float32 [N, 28, 28, 1] in [0, 1].
 
     The /255 normalization and 28×28×1 reshape mirror
-    mnist_dataset.py:10-12.
+    mnist_dataset.py:10-12. Decodes through the native C++ runtime
+    (native/dataloader.cc) when available, NumPy otherwise.
     """
+    from gradaccum_tpu.data import native
+
+    try:
+        native_out = native.read_idx_images(path)
+    except ValueError:
+        native_out = None  # fall through: Python path raises the proper error
+    if native_out is not None:
+        return native_out
     with _open(path) as f:
         magic, n, rows, cols = struct.unpack(">iiii", f.read(16))
         if magic != IMAGE_MAGIC:
@@ -56,6 +65,14 @@ def read_images(path: str) -> np.ndarray:
 
 def read_labels(path: str) -> np.ndarray:
     """Parse an idx1 label file → int32 [N] (mnist_dataset.py:14-16)."""
+    from gradaccum_tpu.data import native
+
+    try:
+        native_out = native.read_idx_labels(path)
+    except ValueError:
+        native_out = None  # fall through: Python path raises the proper error
+    if native_out is not None:
+        return native_out
     with _open(path) as f:
         magic, n = struct.unpack(">ii", f.read(8))
         if magic != LABEL_MAGIC:

@@ -1,0 +1,160 @@
+"""ctypes bindings for the native data-loading runtime (native/dataloader.cc).
+
+The reference's input pipeline runs inside TensorFlow's C++ tf.data runtime
+(/root/reference/distributedExample/mnist_dataset.py:18-23;
+another-example.py:40-47); here the native layer is our own small C++
+library. The Python readers in :mod:`.mnist` and :mod:`.csv` call into it
+when it is available and transparently fall back to their NumPy paths when
+it is not (no compiler, build disabled via ``GRADACCUM_NATIVE=0``, or load
+failure).
+
+Build is lazy: the first import looks for ``native/libgradaccum_data.so``
+and, if missing, runs ``make`` once in that directory.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SO_PATH = os.path.join(_NATIVE_DIR, "libgradaccum_data.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_SO_PATH)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.ga_version.restype = ctypes.c_int
+    lib.ga_idx_images_size.argtypes = [ctypes.c_char_p, i32p, i32p, i32p]
+    lib.ga_idx_images_size.restype = ctypes.c_int
+    lib.ga_idx_read_images.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+    ]
+    lib.ga_idx_read_images.restype = ctypes.c_int
+    lib.ga_idx_labels_size.argtypes = [ctypes.c_char_p, i32p]
+    lib.ga_idx_labels_size.restype = ctypes.c_int
+    lib.ga_idx_read_labels.argtypes = [ctypes.c_char_p, i32p, ctypes.c_int64]
+    lib.ga_idx_read_labels.restype = ctypes.c_int
+    lib.ga_csv_size.argtypes = [ctypes.c_char_p, ctypes.c_int, i32p, i32p]
+    lib.ga_csv_size.restype = ctypes.c_int
+    lib.ga_csv_read.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+    ]
+    lib.ga_csv_read.restype = ctypes.c_int
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if
+    unavailable or disabled."""
+    global _lib, _load_attempted
+    if os.environ.get("GRADACCUM_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        try:
+            _lib = _declare(ctypes.CDLL(_SO_PATH))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _check(rc: int, what: str, path: str):
+    if rc != 0:
+        raise ValueError(f"native {what} failed with code {rc} for {path}")
+
+
+def read_idx_images(path: str) -> Optional[np.ndarray]:
+    """float32 [N, rows, cols, 1] in [0, 1], or None if native is off."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n, rows, cols = ctypes.c_int32(), ctypes.c_int32(), ctypes.c_int32()
+    _check(
+        lib.ga_idx_images_size(path.encode(), ctypes.byref(n), ctypes.byref(rows),
+                               ctypes.byref(cols)),
+        "idx_images_size", path,
+    )
+    out = np.empty(n.value * rows.value * cols.value, np.float32)
+    _check(
+        lib.ga_idx_read_images(
+            path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.size,
+        ),
+        "idx_read_images", path,
+    )
+    return out.reshape(n.value, rows.value, cols.value, 1)
+
+
+def read_idx_labels(path: str) -> Optional[np.ndarray]:
+    """int32 [N], or None if native is off."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = ctypes.c_int32()
+    _check(lib.ga_idx_labels_size(path.encode(), ctypes.byref(n)),
+           "idx_labels_size", path)
+    out = np.empty(n.value, np.int32)
+    _check(
+        lib.ga_idx_read_labels(
+            path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out.size,
+        ),
+        "idx_read_labels", path,
+    )
+    return out
+
+
+def read_csv_numeric(path: str, skip_header: bool = True) -> Optional[Tuple[np.ndarray, int]]:
+    """(float32 [rows, cols] with record_defaults 0.0, cols), or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n_rows, n_cols = ctypes.c_int32(), ctypes.c_int32()
+    _check(
+        lib.ga_csv_size(path.encode(), int(skip_header), ctypes.byref(n_rows),
+                        ctypes.byref(n_cols)),
+        "csv_size", path,
+    )
+    out = np.empty(n_rows.value * n_cols.value, np.float32)
+    _check(
+        lib.ga_csv_read(
+            path.encode(), int(skip_header),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size,
+        ),
+        "csv_read", path,
+    )
+    return out.reshape(n_rows.value, n_cols.value), n_cols.value

@@ -1,0 +1,206 @@
+// Native data-loading runtime for gradaccum_tpu.
+//
+// The reference delegates its entire input pipeline to TensorFlow's C++
+// tf.data runtime (FixedLengthRecordDataset over idx gz files,
+// /root/reference/distributedExample/mnist_dataset.py:18-23; TextLineDataset
+// + decode_csv, /root/reference/another-example.py:40-47). This library is
+// the equivalent native layer here: idx image/label decode (gzip-transparent
+// via zlib) and a numeric CSV parser with record_defaults semantics
+// (unparseable/empty fields -> 0.0f), exposed through a minimal C ABI
+// consumed by ctypes (gradaccum_tpu/data/native.py).
+//
+// Two-phase API: *_size() probes shapes so the Python side can allocate the
+// NumPy output buffer, then *_read() fills it. All functions return 0 on
+// success or a negative error code.
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int kErrOpen = -1;
+constexpr int kErrMagic = -2;
+constexpr int kErrShort = -3;
+constexpr int kErrSize = -4;
+
+constexpr int32_t kImageMagic = 2051;
+constexpr int32_t kLabelMagic = 2049;
+
+// Read the whole (possibly gzipped) file; gzread is transparent for
+// uncompressed input.
+int ReadAll(const char* path, std::vector<unsigned char>* out) {
+  gzFile f = gzopen(path, "rb");
+  if (f == nullptr) return kErrOpen;
+  out->clear();
+  unsigned char buf[1 << 16];
+  int n;
+  while ((n = gzread(f, buf, sizeof(buf))) > 0) {
+    out->insert(out->end(), buf, buf + n);
+  }
+  gzclose(f);
+  return n < 0 ? kErrShort : 0;
+}
+
+// Read exactly the first `len` bytes (the idx header) without decompressing
+// the rest — the size probes run before every full read, so this keeps
+// probe+read at one full decompression instead of two.
+int ReadHeader(const char* path, unsigned char* out, int len) {
+  gzFile f = gzopen(path, "rb");
+  if (f == nullptr) return kErrOpen;
+  int n = gzread(f, out, len);
+  gzclose(f);
+  return n == len ? 0 : kErrShort;
+}
+
+int32_t BigEndian32(const unsigned char* p) {
+  return (int32_t(p[0]) << 24) | (int32_t(p[1]) << 16) | (int32_t(p[2]) << 8) |
+         int32_t(p[3]);
+}
+
+}  // namespace
+
+extern "C" {
+
+int ga_version() { return 1; }
+
+// idx3 images: 16-byte header (magic, n, rows, cols), then n*rows*cols bytes.
+int ga_idx_images_size(const char* path, int32_t* n, int32_t* rows,
+                       int32_t* cols) {
+  unsigned char header[16];
+  int rc = ReadHeader(path, header, 16);
+  if (rc != 0) return rc;
+  if (BigEndian32(header) != kImageMagic) return kErrMagic;
+  *n = BigEndian32(header + 4);
+  *rows = BigEndian32(header + 8);
+  *cols = BigEndian32(header + 12);
+  return 0;  // payload length is validated by ga_idx_read_images
+}
+
+// Fill out[len] with float32 pixels scaled by 1/255 (mnist_dataset.py:10-12).
+int ga_idx_read_images(const char* path, float* out, int64_t len) {
+  std::vector<unsigned char> data;
+  int rc = ReadAll(path, &data);
+  if (rc != 0) return rc;
+  if (data.size() < 16) return kErrShort;
+  if (BigEndian32(data.data()) != kImageMagic) return kErrMagic;
+  int64_t count = int64_t(BigEndian32(data.data() + 4)) *
+                  BigEndian32(data.data() + 8) * BigEndian32(data.data() + 12);
+  if (count != len || data.size() < 16 + size_t(count)) return kErrSize;
+  const unsigned char* src = data.data() + 16;
+  // IEEE division, bit-identical to the NumPy /255.0 reference path
+  for (int64_t i = 0; i < count; ++i) out[i] = src[i] / 255.0f;
+  return 0;
+}
+
+// idx1 labels: 8-byte header (magic, n), then n bytes.
+int ga_idx_labels_size(const char* path, int32_t* n) {
+  unsigned char header[8];
+  int rc = ReadHeader(path, header, 8);
+  if (rc != 0) return rc;
+  if (BigEndian32(header) != kLabelMagic) return kErrMagic;
+  *n = BigEndian32(header + 4);
+  return 0;  // payload length is validated by ga_idx_read_labels
+}
+
+int ga_idx_read_labels(const char* path, int32_t* out, int64_t len) {
+  std::vector<unsigned char> data;
+  int rc = ReadAll(path, &data);
+  if (rc != 0) return rc;
+  if (data.size() < 8) return kErrShort;
+  if (BigEndian32(data.data()) != kLabelMagic) return kErrMagic;
+  int64_t count = BigEndian32(data.data() + 4);
+  if (count != len || data.size() < 8 + size_t(count)) return kErrSize;
+  const unsigned char* src = data.data() + 8;
+  for (int64_t i = 0; i < count; ++i) out[i] = src[i];
+  return 0;
+}
+
+// Numeric CSV probe: rows (after optional header) and columns (from the
+// first data row). Handles CRLF and a missing trailing newline.
+int ga_csv_size(const char* path, int skip_header, int32_t* n_rows,
+                int32_t* n_cols) {
+  std::vector<unsigned char> data;
+  int rc = ReadAll(path, &data);
+  if (rc != 0) return rc;
+  const char* p = reinterpret_cast<const char*>(data.data());
+  const char* end = p + data.size();
+  int32_t rows = 0, cols = 0;
+  bool skipped = skip_header == 0;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    int64_t line_len = line_end - p;
+    if (line_len > 0 && p[line_len - 1] == '\r') --line_len;
+    if (line_len > 0) {
+      if (!skipped) {
+        skipped = true;
+      } else {
+        if (rows == 0) {
+          cols = 1;
+          for (int64_t i = 0; i < line_len; ++i)
+            if (p[i] == ',') ++cols;
+        }
+        ++rows;
+      }
+    }
+    if (nl == nullptr) break;
+    p = nl + 1;
+  }
+  *n_rows = rows;
+  *n_cols = cols;
+  return 0;
+}
+
+// Fill out[n_rows*n_cols] row-major. Unparseable or empty fields become 0.0f
+// (tf.decode_csv record_defaults semantics, another-example.py:64-68).
+// Rows with a different column count than the first row are an error.
+int ga_csv_read(const char* path, int skip_header, float* out, int64_t len) {
+  std::vector<unsigned char> data;
+  int rc = ReadAll(path, &data);
+  if (rc != 0) return rc;
+  const char* p = reinterpret_cast<const char*>(data.data());
+  const char* end = p + data.size();
+  int64_t written = 0;
+  int32_t cols = -1;
+  bool skipped = skip_header == 0;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    int64_t line_len = line_end - p;
+    if (line_len > 0 && p[line_len - 1] == '\r') --line_len;
+    if (line_len > 0) {
+      if (!skipped) {
+        skipped = true;
+      } else {
+        std::string line(p, line_len);
+        int32_t c = 0;
+        size_t start = 0;
+        while (start <= line.size()) {
+          size_t comma = line.find(',', start);
+          size_t field_end = comma == std::string::npos ? line.size() : comma;
+          std::string field = line.substr(start, field_end - start);
+          char* endptr = nullptr;
+          float value = std::strtof(field.c_str(), &endptr);
+          if (endptr == field.c_str()) value = 0.0f;  // record_defaults
+          if (written >= len) return kErrSize;
+          out[written++] = value;
+          ++c;
+          if (comma == std::string::npos) break;
+          start = comma + 1;
+        }
+        if (cols < 0) cols = c;
+        if (c != cols) return kErrSize;
+      }
+    }
+    if (nl == nullptr) break;
+    p = nl + 1;
+  }
+  return written == len ? 0 : kErrSize;
+}
+
+}  // extern "C"

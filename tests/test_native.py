@@ -1,0 +1,144 @@
+"""Native C++ data runtime vs the NumPy reference paths.
+
+Generates real idx/idx-gz/CSV files on disk and checks the ctypes-bound
+native readers produce byte-identical results to the pure-Python readers
+(which themselves mirror the reference's tf.data semantics)."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from gradaccum_tpu.data import csv as csv_lib
+from gradaccum_tpu.data import mnist, native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native runtime not built"
+)
+
+
+def _write_idx_images(path, images_u8, gz=False):
+    n, rows, cols = images_u8.shape
+    payload = struct.pack(">iiii", 2051, n, rows, cols) + images_u8.tobytes()
+    opener = gzip.open if gz else open
+    with opener(path, "wb") as f:
+        f.write(payload)
+
+
+def _write_idx_labels(path, labels_u8, gz=False):
+    payload = struct.pack(">ii", 2049, len(labels_u8)) + labels_u8.tobytes()
+    opener = gzip.open if gz else open
+    with opener(path, "wb") as f:
+        f.write(payload)
+
+
+@pytest.mark.parametrize("gz", [False, True])
+def test_idx_images_native_vs_python(rng, tmp_path, gz):
+    images = rng.integers(0, 256, size=(7, 28, 28)).astype(np.uint8)
+    path = str(tmp_path / ("imgs.gz" if gz else "imgs"))
+    _write_idx_images(path, images, gz=gz)
+
+    out_native = native.read_idx_images(path)
+    assert out_native.shape == (7, 28, 28, 1)
+    assert out_native.dtype == np.float32
+    expected = (images.astype(np.float32) / 255.0).reshape(7, 28, 28, 1)
+    np.testing.assert_array_equal(out_native, expected)
+
+    # and the mnist reader (which routes through native) agrees
+    np.testing.assert_array_equal(mnist.read_images(path), expected)
+
+
+@pytest.mark.parametrize("gz", [False, True])
+def test_idx_labels_native_vs_python(rng, tmp_path, gz):
+    labels = rng.integers(0, 10, size=13).astype(np.uint8)
+    path = str(tmp_path / ("lbls.gz" if gz else "lbls"))
+    _write_idx_labels(path, labels, gz=gz)
+
+    out = native.read_idx_labels(path)
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, labels.astype(np.int32))
+    np.testing.assert_array_equal(mnist.read_labels(path), labels.astype(np.int32))
+
+
+def test_idx_bad_magic_raises(tmp_path):
+    path = str(tmp_path / "bad")
+    with open(path, "wb") as f:
+        f.write(struct.pack(">iiii", 1234, 1, 28, 28) + b"\0" * 784)
+    with pytest.raises(ValueError, match="native"):
+        native.read_idx_images(path)
+
+
+def test_csv_native_vs_python_numeric_table(rng, tmp_path, monkeypatch):
+    """Fully-numeric CSV: native parse must equal the csv-module parse,
+    including record_defaults (empty field -> 0.0)."""
+    columns = [c for c in csv_lib.HOUSING_COLUMNS if c != "CHAS"]
+    path = str(tmp_path / "numeric.csv")
+    n = 23
+    with open(path, "w") as f:
+        f.write(",".join(columns) + "\n")
+        for i in range(n):
+            vals = [f"{rng.uniform(0.1, 99):.6f}" for _ in columns]
+            if i == 5:
+                vals[7] = ""  # empty field -> record_defaults 0.0
+            f.write(",".join(vals) + "\n")
+
+    got = csv_lib.read_csv(path, columns=columns)  # routes through native
+    monkeypatch.setenv("GRADACCUM_NATIVE", "0")
+    want = csv_lib.read_csv(path, columns=columns)  # pure-Python path
+
+    assert set(got) == set(want)
+    for name in want:
+        np.testing.assert_allclose(
+            got[name], want[name], rtol=1e-6, err_msg=f"column {name} differs"
+        )
+    assert got[columns[7]][5] == 0.0  # the empty field
+
+
+def test_csv_categorical_table_uses_python_path(rng, tmp_path):
+    """Tables with categorical columns must keep exact string semantics:
+    empty/OOV CHAS values stay strings (-> all-zero one-hot), never a
+    through-float remap to a valid class."""
+    path = str(tmp_path / "housing.csv")
+    with open(path, "w") as f:
+        f.write(",".join(csv_lib.HOUSING_COLUMNS) + "\n")
+        for i in range(4):
+            vals = [f"{rng.uniform(0.1, 99):.4f}" for _ in csv_lib.HOUSING_COLUMNS]
+            vals[3] = ["0", "1", "", "oov"][i]  # CHAS incl. empty + OOV
+            f.write(",".join(vals) + "\n")
+    got = csv_lib.read_csv(path)
+    assert list(got["CHAS"]) == ["0", "1", "", "oov"]
+    onehot = csv_lib.housing_feature_columns()(
+        {c: got[c] for c in csv_lib.HOUSING_COLUMNS if c != csv_lib.HOUSING_LABEL}
+    )
+    chas_block = onehot[:, -2:]  # CHAS is the last (categorical) block
+    np.testing.assert_array_equal(
+        chas_block, [[1, 0], [0, 1], [0, 0], [0, 0]]
+    )
+
+
+def test_csv_ragged_row_falls_back_to_python(tmp_path):
+    """A ragged row errors in the native parser; read_csv must silently use
+    the csv-module path (which pads with record_defaults) instead."""
+    columns = ["a", "b", "c"]
+    path = str(tmp_path / "ragged.csv")
+    with open(path, "w") as f:
+        f.write("a,b,c\n1,2,3\n4,5\n")  # second row missing a field
+    out = csv_lib.read_csv(path, columns=columns)
+    np.testing.assert_allclose(out["c"], [3.0, 0.0])
+
+
+def test_csv_crlf_and_no_trailing_newline(tmp_path):
+    path = str(tmp_path / "crlf.csv")
+    with open(path, "wb") as f:
+        f.write(b"a,b\r\n1.5,2\r\n3,4.25")  # CRLF + missing final newline
+    out = native.read_csv_numeric(path, skip_header=True)
+    assert out is not None
+    matrix, n_cols = out
+    assert n_cols == 2
+    np.testing.assert_allclose(matrix, [[1.5, 2.0], [3.0, 4.25]])
+
+
+def test_native_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("GRADACCUM_NATIVE", "0")
+    assert native.read_idx_images(str(tmp_path / "whatever")) is None
