@@ -67,6 +67,11 @@ def main(argv=None):
     parser.add_argument("--seq-len", type=int, default=128)  # README.md:72
     parser.add_argument("--warmup-frac", type=float, default=0.1)
     parser.add_argument("--vocab", default=None, help="vocab.txt (else built from corpus)")
+    parser.add_argument(
+        "--hf-checkpoint", default=None,
+        help="saved HuggingFace BERT model dir: fine-tune from pretrained "
+             "weights (the reference's BERT-Small checkpoint, README.md:66-72)",
+    )
     parser.add_argument("--bf16", action="store_true", help="bfloat16 MXU compute")
     parser.add_argument("--full", action="store_true",
                         help="reference scale: 3 epochs over the corpus")
@@ -89,7 +94,18 @@ def main(argv=None):
         train_texts, train_labels = synthetic_text_task(t["num_train"], seed=1)
         eval_texts, eval_labels = synthetic_text_task(t["num_eval"], seed=2)
 
-    tok = load_vocab(args.vocab) if args.vocab else build_vocab(train_texts)
+    vocab_path = args.vocab
+    if args.hf_checkpoint and not vocab_path:
+        # pretrained embeddings are indexed by the checkpoint's vocabulary;
+        # a corpus-built vocab would scramble them silently
+        candidate = Path(args.hf_checkpoint) / "vocab.txt"
+        if not candidate.exists():
+            parser.error(
+                f"--hf-checkpoint has no vocab.txt ({candidate}); pass --vocab "
+                "with the checkpoint's vocabulary file"
+            )
+        vocab_path = str(candidate)
+    tok = load_vocab(vocab_path) if vocab_path else build_vocab(train_texts)
     train = dict(
         tok.encode_batch(train_texts, max_seq_length=args.seq_len),
         label=train_labels,
@@ -107,10 +123,25 @@ def main(argv=None):
     else:
         max_steps = args.max_steps
 
-    cfg = BertConfig.small(
-        vocab_size=max(len(tok.vocab), 128),
-        dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
-    )
+    pretrained = None
+    if args.hf_checkpoint:
+        from gradaccum_tpu.models.bert_checkpoint import load_hf_checkpoint
+
+        cfg, pretrained = load_hf_checkpoint(
+            args.hf_checkpoint, num_classes=2,
+            dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        )
+        if len(tok.vocab) != cfg.vocab_size:
+            parser.error(
+                f"tokenizer vocab ({len(tok.vocab)} entries) does not match "
+                f"the checkpoint vocab_size ({cfg.vocab_size}); pass the "
+                "checkpoint's own vocab.txt via --vocab"
+            )
+    else:
+        cfg = BertConfig.small(
+            vocab_size=max(len(tok.vocab), 128),
+            dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        )
     schedule = gt.warmup_polynomial_decay(
         args.lr, num_train_steps=max_steps,
         num_warmup_steps=int(max_steps * args.warmup_frac),
@@ -122,6 +153,7 @@ def main(argv=None):
                            first_step_quirk=True),  # optimization.py:76-94
         gt.RunConfig(model_dir=model_dir, log_step_count_steps=max(max_steps // 20, 1)),
         mode=args.mode,
+        warm_start=pretrained,
     )
 
     host_batch = micro * (k if args.mode == "scan" else 1)

@@ -63,7 +63,12 @@ class Estimator:
         config: Optional[RunConfig] = None,
         mesh=None,
         mode: str = "streaming",
+        warm_start=None,
     ):
+        """``warm_start``: a params pytree used instead of ``model.init`` for
+        fresh runs (tf.estimator's WarmStartSettings slot — how pretrained
+        BERT weights enter the fine-tune, README.md:66-72). A newer
+        checkpoint in ``model_dir`` still wins, exactly like Estimator."""
         if mode not in ("streaming", "scan"):
             raise ValueError(f"mode must be 'streaming' or 'scan', got {mode!r}")
         self.model = model
@@ -72,6 +77,7 @@ class Estimator:
         self.config = config or RunConfig()
         self.mesh = mesh
         self.mode = mode
+        self.warm_start = warm_start
         self._train_step = None
         self._eval_step = None
         self._predict_fn = None
@@ -83,8 +89,11 @@ class Estimator:
         return self.model.loss
 
     def _init_state(self, sample_batch):
-        rng = jax.random.PRNGKey(self.config.seed)
-        params = self.model.init(rng, sample_batch)
+        if self.warm_start is not None:
+            params = jax.tree.map(jnp.asarray, self.warm_start)
+        else:
+            rng = jax.random.PRNGKey(self.config.seed)
+            params = self.model.init(rng, sample_batch)
         if self.mode == "scan":
             return acc.scan_init(params, self.optimizer)
         return acc.streaming_init(params, self.optimizer)

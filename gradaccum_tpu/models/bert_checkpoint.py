@@ -1,0 +1,190 @@
+"""Pretrained BERT checkpoint loading.
+
+The reference fine-tunes google-research/bert's *pretrained* BERT-Small
+(/root/reference/README.md:14, 66-67) — the checkpoint comes from outside the
+repo. The portable interchange format for those weights today is the
+HuggingFace ``transformers`` state dict (same tensors, renamed), so this
+module maps an HF ``BertModel``/``BertForSequenceClassification`` state dict
+onto the :mod:`gradaccum_tpu.models.bert` parameter tree:
+
+==========================================  =====================================
+HF name                                     ours (under params/bert unless noted)
+==========================================  =====================================
+embeddings.word_embeddings.weight           word_embeddings/embedding
+embeddings.position_embeddings.weight       position_embeddings/embedding
+embeddings.token_type_embeddings.weight     token_type_embeddings/embedding
+embeddings.LayerNorm.{weight,bias}          embeddings_LayerNorm/{scale,bias}
+encoder.layer.N.attention.self.query.*      layer_N/attention/query/*
+encoder.layer.N.attention.self.key.*        layer_N/attention/key/*
+encoder.layer.N.attention.self.value.*      layer_N/attention/value/*
+encoder.layer.N.attention.output.dense.*    layer_N/attention/output/*
+encoder.layer.N.attention.output.LayerNorm  layer_N/attention_LayerNorm
+encoder.layer.N.intermediate.dense.*        layer_N/intermediate/*
+encoder.layer.N.output.dense.*              layer_N/ffn_output/*
+encoder.layer.N.output.LayerNorm            layer_N/output_LayerNorm
+pooler.dense.*                              (top-level) pooler/*
+classifier.*                                (top-level) classifier/*
+==========================================  =====================================
+
+Linear ``weight`` tensors are ``[out, in]`` in torch and transpose to flax
+``kernel`` ``[in, out]``; embedding and LayerNorm tensors map as-is.
+
+No framework import is required for the pure mapping
+(:func:`convert_hf_state_dict` takes any mapping of name → array-like);
+:func:`load_hf_checkpoint` additionally pulls in ``transformers`` to read a
+saved model directory.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np
+
+from gradaccum_tpu.models.bert import BertConfig
+
+
+def _np(x) -> np.ndarray:
+    """torch.Tensor / np.ndarray / array-like → float32 numpy."""
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().numpy()
+    return np.asarray(x, dtype=np.float32)
+
+
+def _dense(sd: Mapping[str, Any], prefix: str) -> Dict[str, np.ndarray]:
+    return {
+        "kernel": _np(sd[f"{prefix}.weight"]).T,  # [out,in] -> [in,out]
+        "bias": _np(sd[f"{prefix}.bias"]),
+    }
+
+
+def _layer_norm(sd: Mapping[str, Any], prefix: str) -> Dict[str, np.ndarray]:
+    return {
+        "scale": _np(sd[f"{prefix}.weight"]),
+        "bias": _np(sd[f"{prefix}.bias"]),
+    }
+
+
+def _embed(sd: Mapping[str, Any], name: str) -> Dict[str, np.ndarray]:
+    return {"embedding": _np(sd[name])}
+
+
+def convert_hf_state_dict(
+    state_dict: Mapping[str, Any],
+    config: BertConfig,
+    num_classes: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Build the ``{"params": ...}`` tree for :class:`BertClassifier`.
+
+    ``state_dict`` keys may carry a leading ``bert.`` (the
+    ``BertForSequenceClassification`` layout) or not (plain ``BertModel``).
+    The classifier head is taken from the checkpoint when present, else
+    zero-initialized (``num_classes`` required then).
+    """
+    sd = dict(state_dict)
+    if any(key.startswith("bert.") for key in sd):
+        sd = {
+            (key[len("bert."):] if key.startswith("bert.") else key): value
+            for key, value in sd.items()
+        }
+
+    bert: Dict[str, Any] = {
+        "word_embeddings": _embed(sd, "embeddings.word_embeddings.weight"),
+        "position_embeddings": _embed(sd, "embeddings.position_embeddings.weight"),
+        "token_type_embeddings": _embed(sd, "embeddings.token_type_embeddings.weight"),
+        "embeddings_LayerNorm": _layer_norm(sd, "embeddings.LayerNorm"),
+    }
+    for i in range(config.num_layers):
+        hf = f"encoder.layer.{i}"
+        bert[f"layer_{i}"] = {
+            "attention": {
+                "query": _dense(sd, f"{hf}.attention.self.query"),
+                "key": _dense(sd, f"{hf}.attention.self.key"),
+                "value": _dense(sd, f"{hf}.attention.self.value"),
+                "output": _dense(sd, f"{hf}.attention.output.dense"),
+            },
+            "attention_LayerNorm": _layer_norm(sd, f"{hf}.attention.output.LayerNorm"),
+            "intermediate": _dense(sd, f"{hf}.intermediate.dense"),
+            "ffn_output": _dense(sd, f"{hf}.output.dense"),
+            "output_LayerNorm": _layer_norm(sd, f"{hf}.output.LayerNorm"),
+        }
+
+    params: Dict[str, Any] = {"bert": bert, "pooler": _dense(sd, "pooler.dense")}
+
+    if "classifier.weight" in sd:
+        head = _dense(sd, "classifier")
+        if num_classes is not None and head["kernel"].shape[1] != num_classes:
+            raise ValueError(
+                f"checkpoint classifier head has {head['kernel'].shape[1]} "
+                f"classes but num_classes={num_classes}; drop the head from "
+                "the state dict or match num_classes"
+            )
+        params["classifier"] = head
+    else:
+        if num_classes is None:
+            raise ValueError(
+                "checkpoint has no classifier head; pass num_classes to "
+                "zero-initialize one (the fine-tune head, README.md:72)"
+            )
+        params["classifier"] = {
+            "kernel": np.zeros((config.hidden_size, num_classes), np.float32),
+            "bias": np.zeros((num_classes,), np.float32),
+        }
+    return {"params": params}
+
+
+def config_from_hf(hf_config, **overrides) -> BertConfig:
+    """BertConfig from a ``transformers.BertConfig``-shaped object.
+
+    Raises on activations our encoder does not implement (it hardcodes the
+    original BERT erf-gelu) rather than converting to a silently different
+    model.
+    """
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act != "gelu":
+        raise ValueError(
+            f"checkpoint uses hidden_act={act!r}; models.bert implements the "
+            "original BERT erf-gelu only — converting would silently change "
+            "the forward pass"
+        )
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        intermediate_size=hf_config.intermediate_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        type_vocab_size=hf_config.type_vocab_size,
+        hidden_dropout=hf_config.hidden_dropout_prob,
+        attention_dropout=hf_config.attention_probs_dropout_prob,
+        layer_norm_eps=hf_config.layer_norm_eps,
+    )
+    kw.update(overrides)
+    return BertConfig(**kw)
+
+
+def load_hf_checkpoint(
+    path: str,
+    num_classes: int = 2,
+    **config_overrides,
+):
+    """Load a saved HF BERT model directory → ``(BertConfig, params)``.
+
+    Equivalent of the reference pointing ``run_classifier.py`` at the
+    downloaded BERT-Small checkpoint dir (README.md:66-72).
+    """
+    import transformers  # gated: only this entry point needs it
+
+    # AutoModel would silently strip a fine-tuned classification head; load
+    # the classification class when the saved config says there is one
+    hf_config = transformers.AutoConfig.from_pretrained(path)
+    architectures = getattr(hf_config, "architectures", None) or []
+    if any("SequenceClassification" in a for a in architectures):
+        model = transformers.AutoModelForSequenceClassification.from_pretrained(path)
+    else:
+        model = transformers.AutoModel.from_pretrained(path)
+    config = config_from_hf(model.config, **config_overrides)
+    params = convert_hf_state_dict(
+        model.state_dict(), config, num_classes=num_classes
+    )
+    return config, params

@@ -178,6 +178,23 @@ def test_train_and_evaluate_scan_max_steps_off_multiple(rng, tmp_path):
     assert "rmse" in results
 
 
+def test_warm_start_params_used(rng):
+    """warm_start params replace model.init for fresh runs (the pretrained
+    BERT entry path)."""
+    warm = {"w": jnp.full((3, 1), 7.0), "b": jnp.full((1,), -1.0)}
+    est = Estimator(
+        _linear_bundle(),
+        sgd(0.0),  # lr 0: params must stay exactly at the warm-start values
+        GradAccumConfig(num_micro_batches=1),
+        RunConfig(),
+        mode="streaming",
+        warm_start=warm,
+    )
+    state = est.train(_input_fn(rng, 32, B), max_steps=2)
+    np.testing.assert_array_equal(np.asarray(state.params["w"]), 7.0)
+    np.testing.assert_array_equal(np.asarray(state.params["b"]), -1.0)
+
+
 def test_accuracy_metric_streaming_uneven_batches():
     m = accuracy(pred_key="classes", label_key="label")
     out1 = {"classes": jnp.asarray([1, 2, 3])}
