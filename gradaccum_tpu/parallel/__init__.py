@@ -1,4 +1,5 @@
-from gradaccum_tpu.parallel import dp, mesh, sharding
+from gradaccum_tpu.parallel import dp, mesh, ring_attention, sharding, tp
+from gradaccum_tpu.parallel.cross_shard import cross_shard_optimizer
 from gradaccum_tpu.parallel.dp import make_dp_train_step, make_pjit_dp_train_step
 from gradaccum_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -9,6 +10,11 @@ from gradaccum_tpu.parallel.mesh import (
     data_parallel_mesh,
     make_mesh,
 )
+from gradaccum_tpu.parallel.ring_attention import (
+    blockwise_attention,
+    make_ring_attention_fn,
+    ring_attention,
+)
 from gradaccum_tpu.parallel.sharding import (
     batch_sharding,
     device_put_batch,
@@ -17,3 +23,4 @@ from gradaccum_tpu.parallel.sharding import (
     replicated,
     shard_params,
 )
+from gradaccum_tpu.parallel.tp import bert_tp_rules

@@ -216,6 +216,75 @@ def test_param_sharding_rules(rng):
     assert placed["dense"]["kernel"].sharding.spec == P(None, "model")
 
 
+def test_cross_shard_optimizer_means_gradients(rng):
+    """CrossShardOptimizer parity (optimization.py:67-68): per-replica
+    gradients are pmean'd before the update, so the result equals a
+    single-device update on the averaged gradient."""
+    from gradaccum_tpu.parallel.cross_shard import cross_shard_optimizer
+
+    mesh = data_parallel_mesh(4)
+    params = make_params(rng)
+    opt = sgd(0.1)
+    xopt = cross_shard_optimizer(opt, axis_name="data")
+
+    per_replica = jnp.stack(
+        [jnp.full((3, 1), float(i)) for i in range(4)]
+    )  # grads differ per replica; mean is 1.5
+
+    def shard_fn(params, grads_w):
+        grads = {"w": grads_w[0], "bias": jnp.zeros((1,))}  # [1,3,1] shard -> [3,1]
+        new_params, _ = xopt.update(grads, xopt.init(params), params,
+                                    jnp.zeros((), jnp.int32))
+        return new_params
+
+    out = jax.jit(
+        jax.shard_map(
+            shard_fn, mesh=mesh, in_specs=(P(), P("data")), out_specs=P()
+        )
+    )(params, per_replica)
+    expected, _ = opt.update(
+        {"w": jnp.full((3, 1), 1.5), "bias": jnp.zeros((1,))},
+        opt.init(params), params, jnp.zeros((), jnp.int32),
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6),
+        jax.device_get(out), jax.device_get(expected),
+    )
+
+
+def test_cross_shard_optimizer_sum_and_validation(rng):
+    from gradaccum_tpu.parallel.cross_shard import cross_shard_optimizer
+
+    mesh = data_parallel_mesh(4)
+    params = make_params(rng)
+    opt = sgd(0.1)
+    xopt = cross_shard_optimizer(opt, axis_name="data", reduction="sum")
+
+    per_replica = jnp.stack([jnp.full((3, 1), float(i)) for i in range(4)])
+
+    def shard_fn(params, grads_w):
+        grads = {"w": grads_w[0], "bias": jnp.zeros((1,))}
+        new_params, _ = xopt.update(grads, xopt.init(params), params,
+                                    jnp.zeros((), jnp.int32))
+        return new_params
+
+    out = jax.jit(
+        jax.shard_map(
+            shard_fn, mesh=mesh, in_specs=(P(), P("data")), out_specs=P()
+        )
+    )(params, per_replica)
+    expected, _ = opt.update(
+        {"w": jnp.full((3, 1), 6.0), "bias": jnp.zeros((1,))},  # 0+1+2+3
+        opt.init(params), params, jnp.zeros((), jnp.int32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["w"]), np.asarray(expected["w"]), rtol=1e-6
+    )
+
+    with pytest.raises(ValueError, match="reduction"):
+        cross_shard_optimizer(opt, reduction="max")
+
+
 def test_mesh_construction():
     m = make_mesh(data=-1)
     assert m.shape == {"data": 8}
