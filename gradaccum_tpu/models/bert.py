@@ -133,8 +133,14 @@ class EncoderLayer(nn.Module):
 
 
 class BertEncoder(nn.Module):
+    """``seq_axis``: when set, the encoder runs sequence-parallel inside
+    ``shard_map`` — inputs hold only this rank's token block, position ids
+    are offset to global positions, and ``attention_fn`` should be
+    ``parallel.ring_attention.make_ring_attention_fn(seq_axis)``."""
+
     config: BertConfig
     attention_fn: Callable = dense_attention
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, input_ids, input_mask=None, segment_ids=None,
@@ -146,12 +152,15 @@ class BertEncoder(nn.Module):
         if segment_ids is None:
             segment_ids = jnp.zeros((B, S), jnp.int32)
 
+        positions = jnp.arange(S)[None, :]
+        if self.seq_axis is not None:
+            # local block of a seq-sharded sequence: global positions
+            positions = positions + jax.lax.axis_index(self.seq_axis) * S
+
         word = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                         name="word_embeddings")(input_ids)
         pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
-                       dtype=cfg.dtype, name="position_embeddings")(
-            jnp.arange(S)[None, :]
-        )
+                       dtype=cfg.dtype, name="position_embeddings")(positions)
         typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                        name="token_type_embeddings")(segment_ids)
         x = word + pos + typ
@@ -174,20 +183,32 @@ class BertEncoder(nn.Module):
 
 
 class BertClassifier(nn.Module):
-    """Encoder + tanh pooler + dropout classifier (run_classifier.py's head)."""
+    """Encoder + tanh pooler + dropout classifier (run_classifier.py's head).
+
+    With ``seq_axis`` set (sequence-parallel), the global [CLS] token lives
+    on seq-rank 0 only; a ``psum`` broadcasts it so the head runs replicated
+    — and VMA-invariant — across the seq axis (head gradients are computed
+    once, not once per shard).
+    """
 
     config: BertConfig
     num_classes: int = 2
     attention_fn: Callable = dense_attention
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, input_ids, input_mask=None, segment_ids=None,
                  deterministic: bool = True):
         cfg = self.config
-        seq = BertEncoder(cfg, self.attention_fn, name="bert")(
+        seq = BertEncoder(cfg, self.attention_fn, self.seq_axis, name="bert")(
             input_ids, input_mask, segment_ids, deterministic
         )
-        cls = seq[:, 0]  # [CLS]
+        cls = seq[:, 0]  # [CLS] (with seq_axis: local token 0 of this block)
+        if self.seq_axis is not None:
+            is_first = jax.lax.axis_index(self.seq_axis) == 0
+            cls = jax.lax.psum(
+                jnp.where(is_first, cls, jnp.zeros_like(cls)), self.seq_axis
+            )
         pooled = jnp.tanh(
             nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="pooler")(cls)
         )
@@ -202,17 +223,35 @@ def bert_classifier_bundle(
     config: BertConfig,
     num_classes: int = 2,
     attention_fn: Callable = dense_attention,
+    seq_axis: Optional[str] = None,
 ) -> ModelBundle:
     """ModelBundle for CoLA/Yelp-style sequence classification.
 
     Batches: ``{"input_ids": [B,S] int32, "input_mask": [B,S] int32,
     "segment_ids": [B,S] int32, "label": [B] int32}`` (+ harness-injected
-    ``"rng"`` for dropout — ``needs_rng=True``).
+    ``"rng"`` for dropout — ``needs_rng=True``). ``seq_axis`` builds the
+    sequence-parallel variant (pair with a ring ``attention_fn``): its
+    ``loss``/``predict`` must run inside ``shard_map`` binding that axis,
+    while ``init`` works anywhere (it runs a dense twin — the parameter
+    tree is identical, so initialization never needs the mesh). Dropout is
+    rejected in sp mode: a replicated rng would draw block-periodic masks,
+    and per-rank keys would break the head's seq-invariance.
     """
-    model = BertClassifier(config, num_classes, attention_fn)
+    if seq_axis is not None and (
+        config.hidden_dropout > 0 or config.attention_dropout > 0
+    ):
+        raise ValueError(
+            "sequence-parallel BERT requires hidden_dropout=0 and "
+            "attention_dropout=0 (standard for long-context training)"
+        )
+    model = BertClassifier(config, num_classes, attention_fn, seq_axis)
+    # dense twin for init: same params, no axis binding required
+    init_model = (
+        BertClassifier(config, num_classes) if seq_axis is not None else model
+    )
 
     def init(rng, sample):
-        return model.init(
+        return init_model.init(
             {"params": rng, "dropout": rng},
             sample["input_ids"],
             sample.get("input_mask"),

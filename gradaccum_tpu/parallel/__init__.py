@@ -1,4 +1,4 @@
-from gradaccum_tpu.parallel import dp, mesh, ring_attention, sharding, tp
+from gradaccum_tpu.parallel import dp, mesh, ring_attention, sharding, sp, tp
 from gradaccum_tpu.parallel.cross_shard import cross_shard_optimizer
 from gradaccum_tpu.parallel.dp import make_dp_train_step, make_pjit_dp_train_step
 from gradaccum_tpu.parallel.mesh import (
@@ -23,4 +23,5 @@ from gradaccum_tpu.parallel.sharding import (
     replicated,
     shard_params,
 )
+from gradaccum_tpu.parallel.sp import make_dp_sp_train_step
 from gradaccum_tpu.parallel.tp import bert_tp_rules

@@ -71,11 +71,13 @@ def _online_block(carry, q, k_blk, v_blk, mask_blk, scale):
 
 
 def _init_carry(q):
-    b, h, s, d = q.shape
+    # derive from q (not jnp.zeros) so the carry inherits q's
+    # varying-manual-axes under shard_map — loop carries must type-match
+    zero = (q * 0).astype(jnp.float32)
     return (
-        jnp.zeros((b, h, s, d), jnp.float32),
-        jnp.full((b, h, s, 1), _NEG_INF, jnp.float32),
-        jnp.zeros((b, h, s, 1), jnp.float32),
+        zero,
+        zero[..., :1] + _NEG_INF,
+        zero[..., :1],
     )
 
 
@@ -151,13 +153,8 @@ def ring_attention(q, k, v, mask=None, dropout_fn=None, *, axis: str = SEQ_AXIS)
         return o, m, l, k_blk, v_blk, mask_blk
 
     # n-1 [compute, rotate] hops in a compiled loop, then the last block's
-    # compute without the wasted final rotate. The zero-init stats are
-    # replica-invariant while the loop produces axis-varying values — pcast
-    # them so the fori_loop carry types line up.
-    init = jax.tree.map(
-        lambda x: lax.pcast(x, axis, to="varying"), _init_carry(q)
-    )
-    carry = init + (k, v, mask)
+    # compute without the wasted final rotate
+    carry = _init_carry(q) + (k, v, mask)
     if n > 1:
         carry = lax.fori_loop(0, n - 1, body, carry)
     o, m, l, k_blk, v_blk, mask_blk = carry
@@ -170,7 +167,12 @@ def make_ring_attention_fn(axis: str = SEQ_AXIS):
     return partial(ring_attention, axis=axis)
 
 
-def shard_seq_batch(batch, mesh, axis: str = SEQ_AXIS, seq_keys=("input_ids", "input_mask", "segment_ids")):
+# batch dict keys carrying a [.., B, S] token dimension to shard over seq
+# (shared with parallel.sp so the two sharding helpers can't disagree)
+SEQ_BATCH_KEYS = ("input_ids", "input_mask", "segment_ids")
+
+
+def shard_seq_batch(batch, mesh, axis: str = SEQ_AXIS, seq_keys=SEQ_BATCH_KEYS):
     """Device_put a dict batch with its sequence dimension sharded over
     ``axis`` (dim 1 of [B, S] features); other leaves replicated."""
     from jax.sharding import NamedSharding, PartitionSpec as P

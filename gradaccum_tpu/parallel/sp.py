@@ -1,0 +1,83 @@
+"""Sequence-parallel (dp × sp) train steps.
+
+Composes ring attention (:mod:`.ring_attention`) into the full training
+step: the batch is sharded over the ``data`` axis AND its token dimension
+over the ``seq`` axis, so a sequence of global length S occupies S/n_seq
+tokens of activation memory per device — long-context training the
+reference cannot express at all (its seq length is a fixed 128,
+/root/reference/README.md:72).
+
+Division of labor with the accumulation transform:
+
+- gradients w.r.t. params are made axis-varying over ``data`` only
+  (``GradAccumConfig.axis_name``), accumulate locally over the K
+  micro-batches, and sync with one explicit ``psum`` per optimizer update;
+- over ``seq``, params stay VMA-*invariant*: each seq rank computes the
+  cotangent contribution of its own token block and JAX's varying-manual-axes
+  machinery inserts the (exact, not averaged) ``psum`` over ``seq`` inside
+  the backward pass. The denominator therefore counts ``K × n_data`` only —
+  seq ranks partition one example's tokens, they do not replicate examples.
+
+The model must be seq-aware (e.g. ``bert_classifier_bundle(...,
+seq_axis="seq", attention_fn=make_ring_attention_fn("seq"))``): global
+position ids and a psum'd [CLS] readout. The rng (dropout) is replicated
+across the mesh so the post-readout head stays seq-invariant.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gradaccum_tpu.ops import accumulation as acc
+from gradaccum_tpu.ops.adamw import Optimizer
+from gradaccum_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+from gradaccum_tpu.parallel.ring_attention import SEQ_BATCH_KEYS as DEFAULT_SEQ_KEYS
+
+
+def make_dp_sp_train_step(
+    loss_fn: acc.LossFn,
+    optimizer: Optimizer,
+    config: acc.GradAccumConfig,
+    mesh: Mesh,
+    data_axis: str = DATA_AXIS,
+    seq_axis: str = SEQ_AXIS,
+    seq_keys: Sequence[str] = DEFAULT_SEQ_KEYS,
+    needs_rng: bool = False,
+):
+    """Scan-mode accumulation step over a ``(data, seq)`` mesh.
+
+    The returned ``train_step(state, super_batch[, rng])`` takes dict
+    super-batches stacked ``[K, B, ...]``; leaves named in ``seq_keys``
+    are ``[K, B, S]`` and get their token dim sharded over ``seq_axis``,
+    everything else shards batch-wise over ``data_axis`` only.
+    """
+    config = config._replace(axis_name=data_axis)
+    inner = acc.accumulate_scan(loss_fn, optimizer, config, needs_rng=needs_rng)
+
+    def batch_specs(batch):
+        if not isinstance(batch, dict):
+            raise TypeError("dp×sp steps require dict batches (seq_keys routing)")
+        return {
+            key: P(None, data_axis, seq_axis) if key in seq_keys
+            else P(None, data_axis)
+            for key in batch
+        }
+
+    jitted = {}
+
+    def train_step(state, super_batch, *rng):
+        key_set = tuple(sorted(super_batch))
+        if key_set not in jitted:
+            in_specs = (P(), batch_specs(super_batch)) + ((P(),) if rng else ())
+            jitted[key_set] = jax.jit(
+                jax.shard_map(
+                    inner, mesh=mesh, in_specs=in_specs, out_specs=(P(), P())
+                ),
+                donate_argnums=0,
+            )
+        return jitted[key_set](state, super_batch, *rng)
+
+    return train_step
