@@ -201,6 +201,12 @@ class Estimator:
         micro_size = None
         last_saved = None
 
+        from gradaccum_tpu.utils.profiling import StepWindowProfiler
+
+        profiler = StepWindowProfiler(
+            cfg.profile_dir, cfg.profile_start_step, cfg.profile_num_steps
+        )
+
         def flush(save_ckpt: bool):
             nonlocal last_saved
             if not cfg.model_dir:
@@ -214,35 +220,42 @@ class Estimator:
                 )
                 loss_rows.clear()
 
-        while True:
-            # scan mode consumes whole K-cycles: stop before overshooting
-            if max_steps is not None and step_no + k > max_steps:
-                break
-            batch = pending if pending is not None else next(it, None)
-            pending = None
-            if batch is None:
-                break
-            if micro_size is None:
-                micro_size = self._micro_size(batch)
-            state, aux = step_fn(state, *self._prep_batch(batch, step_no))
-            step_no += k
-            if cfg.model_dir:
-                loss_rows.append((step_no, aux["loss"]))
-            bucket = step_no // log_every
-            if bucket != last_logged_bucket:
-                dt = time.time() - t0
-                rate = (step_no - steps_at_t0) / max(dt, 1e-9)
-                loss = float(jax.device_get(aux["loss"]))
-                print(
-                    f"[train] step={step_no} loss={loss:.5f} "
-                    f"steps/sec={rate:.2f} examples/sec={rate * micro_size:.1f}"
-                )
-                last_logged_bucket = bucket
-            if (
-                cfg.save_checkpoints_steps
-                and step_no % cfg.save_checkpoints_steps < k
-            ):
-                flush(save_ckpt=True)
+        try:
+            while True:
+                # scan mode consumes whole K-cycles: stop before overshooting
+                if max_steps is not None and step_no + k > max_steps:
+                    break
+                batch = pending if pending is not None else next(it, None)
+                pending = None
+                if batch is None:
+                    break
+                if micro_size is None:
+                    micro_size = self._micro_size(batch)
+                # observe pre-dispatch: the window always traces >=1 step
+                profiler.observe(step_no)
+                state, aux = step_fn(state, *self._prep_batch(batch, step_no))
+                step_no += k
+                if cfg.model_dir:
+                    loss_rows.append((step_no, aux["loss"]))
+                bucket = step_no // log_every
+                if bucket != last_logged_bucket:
+                    dt = time.time() - t0
+                    rate = (step_no - steps_at_t0) / max(dt, 1e-9)
+                    loss = float(jax.device_get(aux["loss"]))
+                    print(
+                        f"[train] step={step_no} loss={loss:.5f} "
+                        f"steps/sec={rate:.2f} examples/sec={rate * micro_size:.1f}"
+                    )
+                    last_logged_bucket = bucket
+                if (
+                    cfg.save_checkpoints_steps
+                    and step_no % cfg.save_checkpoints_steps < k
+                ):
+                    flush(save_ckpt=True)
+        finally:
+            # an exception mid-window must still stop the process-global
+            # profiler (and flush its trace)
+            profiler.close()
 
         flush(save_ckpt=final_save)
         self._state = state

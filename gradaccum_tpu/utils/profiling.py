@@ -1,0 +1,72 @@
+"""Profiling/tracing hooks.
+
+The reference's only observability is wall-clock timing and Estimator's
+steps/sec logging (/root/reference/another-example.py:332-349;
+log_step_count_steps at 284). The harness keeps those (steps/sec +
+examples/sec in the train loop) and adds what TPU work actually needs: a
+``jax.profiler`` trace hook producing TensorBoard/Perfetto traces of the
+XLA execution timeline.
+
+Two entry points:
+
+- :func:`trace` — context manager for ad-hoc profiling of any code region.
+- ``RunConfig(profile_dir=..., profile_start_step=, profile_num_steps=)`` —
+  the Estimator traces that window of train steps automatically.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Trace the enclosed region into ``log_dir`` (TensorBoard-loadable)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepWindowProfiler:
+    """Start/stop a jax.profiler trace when the step counter crosses a
+    window. Host-side, cheap when idle; used by the Estimator train loop."""
+
+    def __init__(self, log_dir: Optional[str], start_step: int, num_steps: int):
+        self.log_dir = log_dir
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._active = False
+        self._done = log_dir is None or num_steps <= 0
+
+    def observe(self, step: int) -> None:
+        """Call BEFORE dispatching the step for ``step`` counter value.
+
+        Stop is only considered while already active, so even when the
+        counter jumps past the whole window in one hop (scan mode with
+        K > num_steps) at least one dispatched step lands inside the trace.
+        """
+        if self._done:
+            return
+        if self._active:
+            if step >= self.stop_step:
+                self.close()
+            return
+        if step >= self.start_step:
+            import jax
+
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            print(f"[profile] trace written to {self.log_dir}")
+        self._done = True

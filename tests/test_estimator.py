@@ -178,6 +178,84 @@ def test_train_and_evaluate_scan_max_steps_off_multiple(rng, tmp_path):
     assert "rmse" in results
 
 
+def test_profile_window_writes_trace(rng, tmp_path):
+    """RunConfig(profile_dir=...) traces the configured step window."""
+    import os
+
+    prof_dir = str(tmp_path / "prof")
+    est = Estimator(
+        _linear_bundle(),
+        adam(5e-2),
+        GradAccumConfig(num_micro_batches=1),
+        RunConfig(profile_dir=prof_dir, profile_start_step=2, profile_num_steps=3),
+        mode="streaming",
+    )
+    est.train(_input_fn(rng, 64, B), max_steps=10)
+    # jax writes plugins/profile/<run>/ under the log dir
+    found = [
+        os.path.join(root, name)
+        for root, _dirs, names in os.walk(prof_dir)
+        for name in names
+    ]
+    assert found, f"no trace files under {prof_dir}"
+
+
+def test_profile_window_smaller_than_k_still_traces(rng, tmp_path):
+    """scan mode with K > profile_num_steps: the window must still contain
+    at least one dispatched step (not an empty start+stop in one call)."""
+    import os
+
+    prof_dir = str(tmp_path / "prof_k")
+    est = Estimator(
+        _linear_bundle(),
+        adam(5e-2),
+        GradAccumConfig(num_micro_batches=8),
+        RunConfig(profile_dir=prof_dir, profile_start_step=10, profile_num_steps=5),
+        mode="scan",
+    )
+    est.train(_input_fn(rng, 256, 8 * B), max_steps=48)
+    found = [n for _r, _d, ns in os.walk(prof_dir) for n in ns]
+    assert found, f"no trace files under {prof_dir}"
+
+
+def test_profiler_stopped_on_train_exception(rng, tmp_path):
+    """An exception mid-window must stop the process-global profiler so a
+    retry in the same process can trace again."""
+    import os
+
+    class Boom(Exception):
+        pass
+
+    def exploding_input():
+        data = _regression_data(np.random.default_rng(0), 64)
+        yield {k: v[:8] for k, v in data.items()}
+        yield {k: v[8:16] for k, v in data.items()}
+        raise Boom()
+
+    prof_dir = str(tmp_path / "prof_exc")
+    est = Estimator(
+        _linear_bundle(),
+        adam(5e-2),
+        GradAccumConfig(num_micro_batches=1),
+        RunConfig(profile_dir=prof_dir, profile_start_step=1, profile_num_steps=100),
+        mode="streaming",
+    )
+    with np.testing.assert_raises(Boom):
+        est.train(exploding_input(), max_steps=50)
+    # profiler was stopped: a fresh trace can start without error
+    est2 = Estimator(
+        _linear_bundle(),
+        adam(5e-2),
+        GradAccumConfig(num_micro_batches=1),
+        RunConfig(profile_dir=str(tmp_path / "prof_exc2"), profile_start_step=1,
+                  profile_num_steps=2),
+        mode="streaming",
+    )
+    est2.train(_input_fn(rng, 32, B), max_steps=6)
+    found = [n for _r, _d, ns in os.walk(str(tmp_path / "prof_exc2")) for n in ns]
+    assert found
+
+
 def test_warm_start_params_used(rng):
     """warm_start params replace model.init for fresh runs (the pretrained
     BERT entry path)."""
