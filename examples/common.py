@@ -11,6 +11,14 @@ import argparse
 import os
 import shutil
 
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    # the TPU plugin's sitecustomize forces its platform at interpreter
+    # startup, so the env var alone is too late — honor an explicit CPU
+    # request before any backend initializes (same guard as __graft_entry__)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 
 def example_argparser(description: str, default_steps: int) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=description)
