@@ -1,0 +1,58 @@
+"""Reproduce the reference's loss-vs-step comparison plots.
+
+The reference validates gradient accumulation empirically with two PNGs
+(/root/reference/Loss_Step.png — BERT with/without accumulation;
+Loss_Step_multiWorker.png — the 4-way MNIST effective-batch-200 matrix,
+README.md:135-139). Every Estimator run here writes ``loss_vs_step.csv``
+into its model_dir; this tool overlays any number of them into the same
+kind of figure.
+
+Usage:
+  python examples/plot_loss.py out.png run1_dir run2_dir ...
+  python examples/plot_loss.py mnist_matrix.png /tmp/gradaccum_runs/mnist_0{1,2,3,4}
+"""
+
+import csv
+import os
+import sys
+
+
+def read_curve(model_dir):
+    path = os.path.join(model_dir, "loss_vs_step.csv")
+    steps, losses = [], []
+    with open(path) as f:
+        for row in csv.DictReader(f):
+            steps.append(int(row["step"]))
+            losses.append(float(row["loss"]))
+    return steps, losses
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 2:
+        print(__doc__)
+        return 1
+    out, run_dirs = argv[0], argv[1:]
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(9, 5))
+    for d in run_dirs:
+        steps, losses = read_curve(d)
+        ax.plot(steps, losses, label=os.path.basename(os.path.normpath(d)),
+                linewidth=1.0, alpha=0.85)
+    ax.set_xlabel("step (micro-batches, reference global_step semantics)")
+    ax.set_ylabel("training loss")
+    ax.legend()
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    print(f"wrote {out} ({len(run_dirs)} curves)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

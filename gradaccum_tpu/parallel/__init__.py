@@ -1,6 +1,7 @@
-from gradaccum_tpu.parallel import dp, mesh, ring_attention, sharding, sp, tp
+from gradaccum_tpu.parallel import dp, mesh, pp, ring_attention, sharding, sp, tp
 from gradaccum_tpu.parallel.cross_shard import cross_shard_optimizer
 from gradaccum_tpu.parallel.dp import make_dp_train_step, make_pjit_dp_train_step
+from gradaccum_tpu.parallel.pp import make_pp_train_step, pp_init, stack_stage_params
 from gradaccum_tpu.parallel.mesh import (
     DATA_AXIS,
     EXPERT_AXIS,
@@ -8,6 +9,7 @@ from gradaccum_tpu.parallel.mesh import (
     PIPE_AXIS,
     SEQ_AXIS,
     data_parallel_mesh,
+    initialize_multihost,
     make_mesh,
 )
 from gradaccum_tpu.parallel.ring_attention import (

@@ -31,6 +31,8 @@ SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 PIPE_AXIS = "pipe"
 
+_multihost_initialized = False
+
 
 def make_mesh(
     axis_sizes: Optional[Sequence[Tuple[str, int]]] = None,
@@ -79,3 +81,50 @@ def data_parallel_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
     if num_devices is not None:
         devices = devices[:num_devices]
     return make_mesh([(DATA_AXIS, len(devices))], devices=devices)
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> dict:
+    """Join this process to a multi-host cluster (the ``TF_CONFIG`` slot).
+
+    The reference builds its 2-worker cluster from a hand-edited TF_CONFIG
+    env JSON per host (/root/reference/distributedExample/03:68-74;
+    README.md:133). JAX's distributed runtime replaces that with a
+    coordinator handshake; afterwards ``jax.devices()`` spans all hosts and
+    every mesh built from it rides ICI within a slice and DCN across slices.
+    On TPU pods all three arguments auto-detect from the environment; set
+    them explicitly for CPU/GPU clusters (coordinator ``host:port``, world
+    size, this process's rank).
+
+    Call this BEFORE any other JAX API — ``jax.distributed.initialize``
+    must run before the XLA backend comes up, so this function deliberately
+    touches no backend-initializing call until after the handshake attempt.
+
+    Returns ``{"process_index", "process_count", "local_devices",
+    "global_devices"}`` for logging. No-op when already initialized.
+    """
+    global _multihost_initialized
+    if not _multihost_initialized:
+        kwargs = {}
+        if coordinator_address is not None:
+            kwargs["coordinator_address"] = coordinator_address
+        if num_processes is not None:
+            kwargs["num_processes"] = num_processes
+        if process_id is not None:
+            kwargs["process_id"] = process_id
+        try:
+            jax.distributed.initialize(**kwargs)
+            _multihost_initialized = True
+        except (ValueError, RuntimeError):
+            if coordinator_address is not None:
+                raise  # explicit cluster request must not fall back silently
+            # auto-detect found no cluster (plain single-process run): fine
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_devices(),
+        "global_devices": jax.devices(),
+    }

@@ -1,0 +1,186 @@
+"""Pipeline parallelism (GPipe schedule) over the ``pipe`` mesh axis.
+
+Not present in the reference (SURVEY.md §2 checklist: PP — NO); this is a
+TPU-native extension that falls naturally out of the accumulation design:
+the K gradient-accumulation micro-batches ARE the pipeline micro-batches.
+GPipe's "split the batch into micro-batches, push them through the stages,
+accumulate gradients, apply once" is exactly what
+:func:`...ops.accumulation.accumulate_scan` already does in time — here the
+stages also partition the *model* across devices.
+
+Mechanics (inside ``shard_map`` over ``pipe``, P stages, K micro-batches):
+
+- stage parameters are stacked ``[P, ...]`` per leaf and sharded so each
+  rank holds its own stage (:func:`stack_stage_params`);
+- for ``T = K + P - 1`` ticks, every rank applies its stage to the buffer it
+  holds and ``ppermute``s the activations one hop down the pipe — rank 0
+  feeds micro-batch ``t`` at tick ``t``, the last rank emits outputs from
+  tick ``P-1`` on (the classic skewed schedule; bubble fraction
+  ``(P-1)/T``);
+- the loss is computed on the last rank and ``psum``-broadcast; autodiff
+  runs backward through the same schedule (the transpose of ``ppermute`` is
+  the reverse permute), leaving each rank exactly its own stage's gradient
+  — no cross-stage gradient collectives at all;
+- each rank then updates its stage's optimizer state locally. The step
+  counter advances by K (micro-batch semantics, optimization.py:102-103).
+
+Requirements: homogeneous stages (``stage_fn(stage_params, x) -> y`` with
+``y.shape == x.shape``) — the transformer-layer-stack case. Embedding/head
+layers belong outside the pipelined region (run them replicated before/after,
+or fold them into the first/last stage with padding).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gradaccum_tpu.ops.adamw import Optimizer
+from gradaccum_tpu.parallel.mesh import PIPE_AXIS
+
+# stage_fn(stage_params, x) -> y, same shape (homogeneous pipeline stages)
+StageFn = Callable[[Any, jnp.ndarray], jnp.ndarray]
+# loss_fn(final_activations, micro_batch) -> scalar mean loss
+PPLossFn = Callable[[jnp.ndarray, Any], jnp.ndarray]
+
+
+class PPState(NamedTuple):
+    params: Any  # stage-stacked [P, ...] per leaf
+    opt_state: Any  # same stacking
+    step: jnp.ndarray
+
+
+def stack_stage_params(stage_params_list) -> Any:
+    """Stack per-stage parameter pytrees into the ``[P, ...]`` layout."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *stage_params_list)
+
+
+def pp_init(stage_params_list, optimizer: Optimizer) -> PPState:
+    params = stack_stage_params(stage_params_list)
+    return PPState(
+        params=params,
+        opt_state=optimizer.init(params),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def pipeline_apply(
+    stage_fn: StageFn,
+    local_params: Any,
+    micro_inputs: jnp.ndarray,
+    axis: str = PIPE_AXIS,
+) -> jnp.ndarray:
+    """Run the skewed GPipe schedule. Must run inside ``shard_map``.
+
+    ``micro_inputs``: ``[K, B, ...]`` (replicated across the pipe axis);
+    returns ``[K, B, ...]`` final-stage outputs, valid on the LAST rank
+    (zeros elsewhere — mask or psum as needed).
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    k = micro_inputs.shape[0]
+    ticks = k + n - 1
+    perm = [(i, i + 1) for i in range(n - 1)]
+
+    buf = jnp.zeros_like(micro_inputs[0])
+    outs = jnp.zeros_like(micro_inputs)
+    for t in range(ticks):  # static unroll: T is small (K + P - 1)
+        feed = micro_inputs[t] if t < k else jnp.zeros_like(buf)
+        x = jnp.where(idx == 0, feed, buf)
+        y = stage_fn(local_params, x)
+        if t >= n - 1:
+            outs = outs.at[t - n + 1].set(
+                jnp.where(idx == n - 1, y, jnp.zeros_like(y))
+            )
+        if n > 1:
+            buf = lax.ppermute(y, axis, perm)
+    return outs
+
+
+def make_pp_train_step(
+    stage_fn: StageFn,
+    loss_fn: PPLossFn,
+    optimizer: Optimizer,
+    num_micro_batches: int,
+    mesh: Mesh,
+    axis: str = PIPE_AXIS,
+    input_key: str = "x",
+):
+    """Build ``train_step(state, batch) -> (state, aux)``.
+
+    ``batch`` is a dict whose ``input_key`` leaf is stacked ``[K, B, ...]``
+    (use ``stack_micro_batches``); the remaining leaves (labels) are passed
+    per-micro-batch to ``loss_fn``. State/params are stage-stacked; the
+    returned step is jitted with state donated.
+    """
+    k = num_micro_batches
+
+    def step(state: PPState, batch):
+        n = lax.axis_size(axis)
+        idx = lax.axis_index(axis)
+        local_params = jax.tree.map(lambda p: p[0], state.params)
+
+        def fwd(local_params):
+            outs = pipeline_apply(stage_fn, local_params, batch[input_key], axis)
+            labels = {key: v for key, v in batch.items() if key != input_key}
+            losses = jax.vmap(
+                lambda out, lbl: loss_fn(out, lbl)
+            )(outs, labels)
+            local = jnp.mean(losses)
+            # only the last rank saw real outputs; broadcast its loss
+            return lax.psum(jnp.where(idx == n - 1, local, 0.0), axis)
+
+        loss, local_grads = jax.value_and_grad(fwd)(local_params)
+        # re-stack to the [1, ...] local slice of the stage-stacked layout
+        grads = jax.tree.map(lambda g: g[None], local_grads)
+        apply_step = state.step + k
+        new_params, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params, apply_step
+        )
+        return (
+            PPState(new_params, new_opt_state, apply_step),
+            {"loss": loss},
+        )
+
+    n_stages = dict(mesh.shape)[axis]
+
+    def leaf_spec(leaf):
+        # stage-stacked leaves carry the [P, ...] leading dim; anything else
+        # (e.g. a bias-corrected Adam's scalar step counter) is replicated
+        stacked = getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n_stages
+        return P(axis) if stacked else P()
+
+    def state_specs(state):
+        return PPState(
+            params=jax.tree.map(leaf_spec, state.params),
+            opt_state=jax.tree.map(leaf_spec, state.opt_state),
+            step=P(),
+        )
+
+    jitted = {}
+
+    def train_step(state, batch):
+        kk = batch[input_key].shape[0]
+        if kk != k:
+            raise ValueError(
+                f"batch[{input_key!r}] is stacked [{kk}, ...] but the step was "
+                f"built with num_micro_batches={k}; the step counter and LR "
+                "schedule would silently desync"
+            )
+        key = tuple(sorted(batch))
+        if key not in jitted:
+            in_specs = (state_specs(state), jax.tree.map(lambda _: P(), batch))
+            jitted[key] = jax.jit(
+                jax.shard_map(
+                    step, mesh=mesh, in_specs=in_specs,
+                    out_specs=(state_specs(state), P()),
+                ),
+                donate_argnums=0,
+            )
+        return jitted[key](state, batch)
+
+    return train_step
