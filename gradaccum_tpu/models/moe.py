@@ -1,0 +1,123 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+Not in the reference (SURVEY.md §2 checklist: EP — NO); this completes the
+parallelism suite (dp/tp/sp/pp/ep) the TPU rebuild is designed around.
+
+Switch-Transformer-style top-1 routing with fixed expert capacity:
+
+- router: ``logits = x @ w_router`` → softmax gates, top-1 expert per token;
+- capacity ``C = ceil(tokens/E · capacity_factor)``: position-in-expert via
+  a cumulative sum over tokens; tokens beyond an expert's capacity are
+  dropped (pass through the residual — the layer returns zeros for them);
+- dispatch/combine as einsums against a ``[T, E, C]`` one-hot tensor — the
+  MXU-friendly formulation (no gathers/scatters, static shapes);
+- auxiliary load-balancing loss ``E · Σ_e fraction_tokens_e ·
+  mean_gate_e`` (Switch eq. 4) returned alongside the output;
+- expert FFN weights are stacked ``[E, d, h]``/``[E, h, d]``.
+
+**Expert parallelism** is a sharding, not new code: shard the expert dim of
+``w_in``/``w_out`` (and the dispatched ``[E, C, D]`` activations) over the
+``expert`` mesh axis with :func:`moe_ep_rules` and jit — GSPMD turns the
+dispatch/combine einsums into the all-to-all pattern over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gradaccum_tpu.parallel.mesh import EXPERT_AXIS
+
+
+def moe_init(
+    rng: jax.Array,
+    d_model: int,
+    d_hidden: int,
+    num_experts: int,
+    dtype=jnp.float32,
+) -> Dict[str, Any]:
+    """Parameters: router [D, E], expert FFNs stacked [E, D, H]/[E, H, D]."""
+    k_router, k_in, k_out = jax.random.split(rng, 3)
+    scale_in = 1.0 / np.sqrt(d_model)
+    scale_out = 1.0 / np.sqrt(d_hidden)
+    return {
+        "router": (jax.random.normal(k_router, (d_model, num_experts)) * scale_in).astype(dtype),
+        "w_in": (jax.random.normal(k_in, (num_experts, d_model, d_hidden)) * scale_in).astype(dtype),
+        "b_in": jnp.zeros((num_experts, d_hidden), dtype),
+        "w_out": (jax.random.normal(k_out, (num_experts, d_hidden, d_model)) * scale_out).astype(dtype),
+        "b_out": jnp.zeros((num_experts, d_model), dtype),
+    }
+
+
+def moe_apply(
+    params: Dict[str, Any],
+    x: jnp.ndarray,
+    capacity_factor: float = 1.25,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Apply the MoE FFN to ``x: [..., T, D]`` (leading dims folded into T).
+
+    Returns ``(y, aux)`` with ``y`` zero for dropped tokens (add the
+    residual outside) and ``aux = {"load_balance_loss", "dropped_fraction",
+    "router_entropy"}``.
+    """
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    x2 = x.reshape(-1, d)  # [T, D]
+    t = x2.shape[0]
+    e = params["router"].shape[-1]
+    capacity = int(np.ceil(t / e * capacity_factor))
+
+    logits = (x2 @ params["router"]).astype(jnp.float32)  # [T, E]
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)  # [T]
+    gate = jnp.max(gates, axis=-1)  # [T]
+
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T, E]
+    # position of each token within its expert's queue (0-based)
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # [T, E], -1 elsewhere
+    keep = (position >= 0) & (position < capacity)  # [T, E]; ≤1 true per row
+    # each kept token's slot index; keep masks out dropped tokens entirely
+    pos = (position * keep).sum(axis=-1).astype(jnp.int32)  # [T]
+    dispatch = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [T, C]
+    dispatch = dispatch[:, None, :] * keep.astype(jnp.float32)[:, :, None]  # [T,E,C]
+
+    compute_dtype = x2.dtype
+    dispatch_c = dispatch.astype(compute_dtype)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch_c, x2)  # [E, C, D]
+    h = jnp.einsum("ecd,edh->ech", expert_in, params["w_in"])
+    h = jax.nn.gelu(h + params["b_in"][:, None, :], approximate=False)
+    out = jnp.einsum("ech,ehd->ecd", h, params["w_out"])
+    out = out + params["b_out"][:, None, :]
+    combine = dispatch_c * gate.astype(compute_dtype)[:, None, None]
+    y = jnp.einsum("tec,ecd->td", combine, out)  # [T, D]; zeros for dropped
+
+    # Switch load-balancing loss: E · Σ_e (token fraction)·(mean gate)
+    token_frac = jnp.mean(onehot, axis=0)
+    gate_mean = jnp.mean(gates, axis=0)
+    load_balance = e * jnp.sum(token_frac * gate_mean)
+    dropped = 1.0 - jnp.sum(dispatch) / t
+    entropy = -jnp.mean(jnp.sum(gates * jnp.log(gates + 1e-9), axis=-1))
+
+    aux = {
+        "load_balance_loss": load_balance,
+        "dropped_fraction": dropped,
+        "router_entropy": entropy,
+    }
+    return y.reshape(orig_shape), aux
+
+
+def moe_ep_rules(axis: str = EXPERT_AXIS):
+    """Sharding rules (for ``parallel.sharding.shard_params``): expert dim
+    of every expert-stacked leaf over the ``expert`` mesh axis. Router
+    stays replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        (r"w_in", P(axis, None, None)),
+        (r"b_in", P(axis, None)),
+        (r"w_out", P(axis, None, None)),
+        (r"b_out", P(axis, None)),
+    ]

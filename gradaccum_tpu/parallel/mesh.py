@@ -34,6 +34,17 @@ PIPE_AXIS = "pipe"
 _multihost_initialized = False
 
 
+def _distributed_client_active() -> bool:
+    """Was jax.distributed initialized (by anyone)? Private-API probe with a
+    conservative False on JAX-internal changes."""
+    try:
+        from jax._src import distributed as _distributed
+
+        return _distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
 def make_mesh(
     axis_sizes: Optional[Sequence[Tuple[str, int]]] = None,
     *,
@@ -107,6 +118,12 @@ def initialize_multihost(
     "global_devices"}`` for logging. No-op when already initialized.
     """
     global _multihost_initialized
+    if _distributed_client_active():
+        # jax.distributed was initialized elsewhere: honor the no-op promise
+        _multihost_initialized = True
+    explicit = any(
+        a is not None for a in (coordinator_address, num_processes, process_id)
+    )
     if not _multihost_initialized:
         kwargs = {}
         if coordinator_address is not None:
@@ -119,9 +136,10 @@ def initialize_multihost(
             jax.distributed.initialize(**kwargs)
             _multihost_initialized = True
         except (ValueError, RuntimeError):
-            if coordinator_address is not None:
+            if explicit:
                 raise  # explicit cluster request must not fall back silently
             # auto-detect found no cluster (plain single-process run): fine
+            _multihost_initialized = True
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),

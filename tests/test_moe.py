@@ -1,0 +1,96 @@
+"""MoE layer: routing/capacity semantics + expert-parallel sharding parity."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gradaccum_tpu.models.moe import moe_apply, moe_ep_rules, moe_init
+from gradaccum_tpu.parallel.mesh import make_mesh
+from gradaccum_tpu.parallel.sharding import shard_params
+
+T, D, H, E = 32, 8, 16, 4
+
+
+@pytest.fixture
+def params():
+    return moe_init(jax.random.PRNGKey(0), D, H, E)
+
+
+def _x(rng, t=T):
+    return jnp.asarray(rng.normal(size=(t, D)), jnp.float32)
+
+
+def _reference_per_token(params, x, capacity_factor):
+    """Route each token with a Python loop — the semantic spec."""
+    logits = np.asarray(x @ params["router"], np.float64)
+    gates = np.exp(logits - logits.max(-1, keepdims=True))
+    gates /= gates.sum(-1, keepdims=True)
+    idx = gates.argmax(-1)
+    capacity = int(np.ceil(x.shape[0] / E * capacity_factor))
+    counts = {e: 0 for e in range(E)}
+    out = np.zeros_like(np.asarray(x, np.float64))
+    for t in range(x.shape[0]):
+        e = int(idx[t])
+        if counts[e] < capacity:
+            counts[e] += 1
+            h = np.asarray(x[t] @ params["w_in"][e] + params["b_in"][e], np.float64)
+            h = 0.5 * h * (1 + np.vectorize(math.erf)(h / np.sqrt(2)))
+            y = h @ params["w_out"][e] + params["b_out"][e]
+            out[t] = gates[t, e] * y
+    return out
+
+
+def test_moe_matches_per_token_reference(rng, params):
+    x = _x(rng)
+    y, aux = moe_apply(params, x, capacity_factor=1.25)
+    want = _reference_per_token(jax.device_get(params), np.asarray(x), 1.25)
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-5)
+    assert 0.0 <= float(aux["dropped_fraction"]) < 1.0
+    assert float(aux["load_balance_loss"]) >= 1.0 - 1e-3  # ≥1 at any routing
+
+
+def test_moe_capacity_drops_tokens(rng, params):
+    """With capacity_factor well under 1, some tokens must drop to zeros."""
+    x = _x(rng)
+    y, aux = moe_apply(params, x, capacity_factor=0.25)
+    assert float(aux["dropped_fraction"]) > 0.0
+    dropped_rows = np.where(np.all(np.asarray(y) == 0.0, axis=-1))[0]
+    assert len(dropped_rows) >= 1
+
+
+def test_moe_leading_dims_folded(rng, params):
+    """[B, S, D] inputs fold into tokens and reshape back."""
+    x = jnp.asarray(rng.normal(size=(2, T // 2, D)), jnp.float32)
+    y, _ = moe_apply(params, x)
+    assert y.shape == x.shape
+    flat_y, _ = moe_apply(params, x.reshape(-1, D))
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, D), np.asarray(flat_y))
+
+
+def test_moe_gradients_flow(rng, params):
+    x = _x(rng)
+
+    def loss(p):
+        y, aux = moe_apply(p, x)
+        return jnp.mean(y**2) + 0.01 * aux["load_balance_loss"]
+
+    grads = jax.grad(loss)(params)
+    norms = jax.tree.map(lambda g: float(jnp.linalg.norm(g)), grads)
+    assert norms["router"] > 0  # load-balance loss reaches the router
+    assert norms["w_in"] > 0 and norms["w_out"] > 0
+
+
+def test_moe_expert_parallel_matches_single_device(rng, params):
+    """EP is a sharding: expert-dim-sharded params + jit must give the same
+    output as the unsharded layer."""
+    x = _x(rng)
+    want, _ = moe_apply(params, x)
+
+    mesh = make_mesh(expert=4, devices=jax.devices()[:4])
+    sharded = shard_params(params, mesh, moe_ep_rules())
+    f = jax.jit(lambda p, x: moe_apply(p, x)[0])
+    got = f(sharded, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
