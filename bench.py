@@ -1,33 +1,83 @@
 """Benchmark: BERT-Small fine-tune throughput at effective batch 32 (8 x 4).
 
-The reference's headline configuration (README.md:60-78): BERT-Small
-L-4 H-512 A-8, seq 128, per-device micro-batch 8, K=4 gradient accumulation.
-North-star from BASELINE.json: >= 1,000 seq/s on TPU.
+The reference's headline configuration (/root/reference/README.md:60-78):
+BERT-Small L-4 H-512 A-8, seq 128, per-device micro-batch 8, K=4 gradient
+accumulation. North-star from BASELINE.json: >= 1,000 seq/s on TPU.
 
 Measures the full scan-mode train step (forward + backward + AdamW with
-warmup/decay schedule + clip-after-average) in bfloat16 on whatever device
-JAX provides, and prints ONE JSON line.
+warmup/decay schedule + clip-after-average) in bfloat16 and prints ONE JSON
+line with both raw throughput (seq/s) and MFU from an analytic FLOPs model.
+
+Resilience: the axon TPU tunnel is known to flake at backend init (it cost
+round 1 its perf artifact). JAX caches a failed backend init for the life of
+the process, so the measurement runs in a child process; this parent retries
+with backoff, captures the child's stderr as diagnostics, and finally falls
+back to CPU (clearly labeled) so the driver always gets a parsable line.
 """
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+K, MICRO, SEQ = 4, 8, 128
+VOCAB = 30522
+NUM_CLASSES = 2
+
+# bf16 peak FLOP/s per chip by device_kind substring (public spec sheets).
+PEAK_FLOPS = [
+    ("v5 lite", 197e12),  # TPU v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6", 918e12),  # Trillium
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
 
-def main():
+def bert_train_flops_per_seq(hidden, layers, intermediate, seq, num_classes):
+    """Analytic fwd+bwd matmul FLOPs for one sequence.
+
+    Per token per layer: QKVO projections 4*(2*H*H) + FFN 2*(2*H*I);
+    attention scores+context 2*(2*S*H). Pooler + classifier per sequence.
+    Backward ~= 2x forward (grads w.r.t. both inputs and weights), so
+    train = 3x fwd. Embedding gather/scatter-add contribute ~0 matmul FLOPs.
+    """
+    per_tok = layers * (8 * hidden * hidden + 4 * hidden * intermediate
+                        + 4 * seq * hidden)
+    fwd = seq * per_tok + 2 * hidden * hidden + 2 * hidden * num_classes
+    return 3 * fwd
+
+
+def peak_flops_for(device_kind):
+    kind = device_kind.lower()
+    for sub, peak in PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def measure(iters, warmup):
+    from gradaccum_tpu.utils.platform import honor_cpu_platform_request
+
+    honor_cpu_platform_request()
+
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     import gradaccum_tpu as gt
     from gradaccum_tpu.models.bert import BertConfig, bert_classifier_bundle
     from gradaccum_tpu.ops.accumulation import scan_init
 
-    K, MICRO, SEQ = 4, 8, 128
-    VOCAB = 30522
+    dev = jax.devices()[0]
+    print(f"[bench] device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
 
     cfg = BertConfig.small(vocab_size=VOCAB, dtype=jnp.bfloat16)
-    bundle = bert_classifier_bundle(cfg, num_classes=2)
+    bundle = bert_classifier_bundle(cfg, num_classes=NUM_CLASSES)
 
     rng = np.random.default_rng(0)
     batch = {
@@ -55,12 +105,10 @@ def main():
     stacked = gt.stack_micro_batches(batch, K)
     key = jax.random.PRNGKey(1)
 
-    # compile + warmup
-    for _ in range(3):
+    for _ in range(warmup):
         state, aux = step(state, stacked, key)
     jax.block_until_ready(aux["loss"])
 
-    iters = 30
     t0 = time.perf_counter()
     for _ in range(iters):
         state, aux = step(state, stacked, key)
@@ -68,13 +116,137 @@ def main():
     dt = time.perf_counter() - t0
 
     seqs_per_sec = iters * K * MICRO / dt
-    print(json.dumps({
+    flops_per_seq = bert_train_flops_per_seq(
+        cfg.hidden_size, cfg.num_layers, cfg.intermediate_size, SEQ, NUM_CLASSES
+    )
+    peak = peak_flops_for(dev.device_kind)
+    mfu = (seqs_per_sec * flops_per_seq / peak) if peak else None
+    return {
         "metric": "bert_small_seq128_effbatch32_train_throughput",
         "value": round(seqs_per_sec, 2),
         "unit": "seq/s",
         "vs_baseline": round(seqs_per_sec / 1000.0, 4),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "flops_per_seq": flops_per_seq,
+        "device": f"{dev.device_kind} ({dev.platform}) x{jax.device_count()}",
+    }
+
+
+def run_worker(args):
+    result = measure(args.iters, args.warmup)
+    print(json.dumps(result))
+
+
+def _probe_backend(env, timeout_s=120):
+    """Cheap liveness check: can a fresh process see the accelerator at all?
+    The axon tunnel's failure mode is a HANG at backend init, so burning a
+    full measurement timeout on a dead tunnel wastes most of the budget."""
+    code = (
+        "import os, jax\n"
+        "if os.environ.get('JAX_PLATFORMS', '').startswith('cpu'):\n"
+        "    jax.config.update('jax_platforms', 'cpu')\n"
+        "print('PROBE_OK', jax.devices()[0].platform)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"probe hang (> {timeout_s}s)"
+    if proc.returncode == 0 and "PROBE_OK" in proc.stdout:
+        platform = proc.stdout.strip().split()[-1]
+        return platform, proc.stdout.strip()
+    tail = (proc.stderr or "").strip().splitlines()[-2:]
+    return None, f"probe rc={proc.returncode} " + " | ".join(tail)[:300]
+
+
+def run_orchestrator():
+    """Retry the measurement in child processes; never exit without a JSON line."""
+    script = os.path.abspath(__file__)
+    attempts = []
+    plans = [
+        # (extra_env, iters, warmup, timeout_s, label)
+        ({}, 30, 3, 900, "attempt-1"),
+        ({}, 30, 3, 900, "attempt-2"),
+        ({}, 30, 3, 900, "attempt-3"),
+        ({"JAX_PLATFORMS": "cpu"}, 3, 1, 1800, "cpu-fallback"),
+    ]
+    backoff = [0, 30, 90, 10]
+    cpu_only = False  # a probe proved this environment has no accelerator
+    for (extra_env, iters, warmup, timeout_s, label), wait in zip(plans, backoff):
+        wants_cpu = extra_env.get("JAX_PLATFORMS", "").startswith("cpu")
+        if cpu_only and not wants_cpu:
+            attempts.append(f"{label}: skipped (environment is cpu-only)")
+            continue
+        if wait:
+            print(f"[bench] backing off {wait}s before {label}", file=sys.stderr)
+            time.sleep(wait)
+        env = dict(os.environ, **extra_env)
+        platform, detail = _probe_backend(env)
+        print(f"[bench] {label} probe: {detail}", file=sys.stderr)
+        if platform is None:
+            attempts.append(f"{label}: backend probe failed ({detail})")
+            continue
+        if not wants_cpu and platform == "cpu":
+            # an accelerator attempt that would silently measure CPU: this is
+            # deterministic (the env is CPU-forced), so skip straight to the
+            # short, clearly-labeled cpu-fallback plan
+            attempts.append(f"{label}: probe found cpu, not an accelerator")
+            cpu_only = True
+            continue
+        cmd = [sys.executable, script, "--worker",
+               "--iters", str(iters), "--warmup", str(warmup)]
+        print(f"[bench] {label}: {' '.join(cmd)}", file=sys.stderr)
+        try:
+            proc = subprocess.run(
+                cmd, env=env, capture_output=True, text=True, timeout=timeout_s
+            )
+        except subprocess.TimeoutExpired:
+            attempts.append(f"{label}: timeout after {timeout_s}s")
+            print(f"[bench] {label} timed out", file=sys.stderr)
+            continue
+        sys.stderr.write(proc.stderr)
+        if proc.returncode == 0:
+            for line in reversed(proc.stdout.strip().splitlines()):
+                try:
+                    result = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            else:
+                attempts.append(f"{label}: rc=0 but no JSON line")
+                continue
+            if attempts:
+                result["bench_attempts"] = attempts + [f"{label}: ok"]
+            print(json.dumps(result))
+            return 0
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        attempts.append(f"{label}: rc={proc.returncode} " + " | ".join(tail)[:400])
+    # Every attempt failed: still print one parsable JSON line with diagnostics.
+    print(json.dumps({
+        "metric": "bert_small_seq128_effbatch32_train_throughput",
+        "value": 0.0,
+        "unit": "seq/s",
+        "vs_baseline": 0.0,
+        "mfu": None,
+        "error": "all bench attempts failed",
+        "bench_attempts": attempts,
     }))
+    return 1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args()
+    if args.worker:
+        run_worker(args)
+        return 0
+    return run_orchestrator()
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

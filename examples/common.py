@@ -11,13 +11,9 @@ import argparse
 import os
 import shutil
 
-if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-    # the TPU plugin's sitecustomize forces its platform at interpreter
-    # startup, so the env var alone is too late — honor an explicit CPU
-    # request before any backend initializes (same guard as __graft_entry__)
-    import jax
+from gradaccum_tpu.utils.platform import honor_cpu_platform_request
 
-    jax.config.update("jax_platforms", "cpu")
+honor_cpu_platform_request()
 
 
 def example_argparser(description: str, default_steps: int) -> argparse.ArgumentParser:

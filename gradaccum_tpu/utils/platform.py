@@ -1,0 +1,20 @@
+"""Platform-selection workaround for environments whose site customization
+forces an accelerator plugin's JAX platform at interpreter startup (before
+``main`` runs), which would otherwise override an explicit
+``JAX_PLATFORMS=cpu`` request from the user or a test/CI driver."""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_cpu_platform_request() -> bool:
+    """If the environment explicitly asks for CPU, force the jax config back
+    to CPU (undoing any sitecustomize override). Call before first backend
+    use. Returns True when CPU was requested."""
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    return False
