@@ -134,13 +134,56 @@ class Estimator:
         predict = self.model.predict
         metrics = self.model.eval_metrics
 
-        @jax.jit
         def eval_step(params, batch):
             outputs = predict(params, batch)
             return {name: m.update(outputs, batch) for name, m in metrics.items()}
 
-        self._eval_step = eval_step
-        return eval_step
+        self._eval_step = self._mesh_dispatch(eval_step)
+        return self._eval_step
+
+    def _mesh_dispatch(self, fn):
+        """Wrap ``fn(params, batch)`` so that, when a mesh with a data axis
+        is configured, eval/predict batches are laid out over ``data`` and
+        XLA (GSPMD) runs the step sharded, reducing metric partials
+        on-device — the reference's ``eval_distribute`` slot
+        (distributedExample/03:83-89). Placement is per-leaf: leaves with a
+        leading batch dim shard over ``data``, anything else (scalar or
+        per-batch metadata) replicates. Batches whose leading dim doesn't
+        divide the data axis (the uneven final batch) run on the default
+        device instead, keeping streaming-metric semantics exact."""
+        from gradaccum_tpu.parallel.mesh import DATA_AXIS
+        from gradaccum_tpu.parallel.sharding import batch_sharding, replicated
+
+        jitted = jax.jit(fn)
+        n_data = dict(self.mesh.shape).get(DATA_AXIS, 1) if self.mesh else 1
+        if n_data <= 1:
+            return jitted
+        rep = replicated(self.mesh)
+        shard = batch_sharding(self.mesh)
+        # identity-keyed memo holding a strong ref to the key pytree (bare
+        # id() could be recycled after the old params are freed)
+        memo = {"source": None, "placed": None}
+
+        def dispatch(params, batch):
+            dims = {
+                l.shape[0]
+                for l in jax.tree.leaves(batch)
+                if getattr(l, "ndim", 0) >= 1
+            }
+            if len(dims) == 1 and next(iter(dims)) % n_data == 0:
+                batch = jax.tree.map(
+                    lambda l: jax.device_put(
+                        l, shard if getattr(l, "ndim", 0) >= 1 else rep
+                    ),
+                    batch,
+                )
+                if memo["source"] is not params:
+                    memo["source"] = params
+                    memo["placed"] = jax.device_put(params, rep)
+                params = memo["placed"]
+            return jitted(params, batch)
+
+        return dispatch
 
     # -- batches ---------------------------------------------------------
 
@@ -207,6 +250,15 @@ class Estimator:
             cfg.profile_dir, cfg.profile_start_step, cfg.profile_num_steps
         )
 
+        def flush_loss_rows():
+            # fetch pending device scalars and clear the list, so a long run
+            # never pins more than ~one log window of live device buffers
+            if loss_rows:
+                self._append_loss_csv(
+                    [(s, float(v)) for s, v in jax.device_get(loss_rows)]
+                )
+                loss_rows.clear()
+
         def flush(save_ckpt: bool):
             nonlocal last_saved
             if not cfg.model_dir:
@@ -214,11 +266,7 @@ class Estimator:
             if save_ckpt and last_saved != step_no:
                 ckpt_lib.save(cfg.model_dir, state, step_no, cfg.keep_checkpoint_max)
                 last_saved = step_no
-            if loss_rows:
-                self._append_loss_csv(
-                    [(s, float(v)) for s, v in jax.device_get(loss_rows)]
-                )
-                loss_rows.clear()
+            flush_loss_rows()
 
         try:
             while True:
@@ -237,6 +285,8 @@ class Estimator:
                 step_no += k
                 if cfg.model_dir:
                     loss_rows.append((step_no, aux["loss"]))
+                    if len(loss_rows) >= 4096:  # hard cap for huge log cadences
+                        flush_loss_rows()
                 bucket = step_no // log_every
                 if bucket != last_logged_bucket:
                     dt = time.time() - t0
@@ -247,6 +297,7 @@ class Estimator:
                         f"steps/sec={rate:.2f} examples/sec={rate * micro_size:.1f}"
                     )
                     last_logged_bucket = bucket
+                    flush_loss_rows()
                 if (
                     cfg.save_checkpoints_steps
                     and step_no % cfg.save_checkpoints_steps < k
@@ -314,7 +365,7 @@ class Estimator:
             return
         params = self._params_for_inference(first, state, checkpoint_path)
         if self._predict_fn is None:
-            self._predict_fn = jax.jit(self.model.predict)
+            self._predict_fn = self._mesh_dispatch(self.model.predict)
         predict = self._predict_fn
         batch = first
         while batch is not None:
