@@ -124,6 +124,16 @@ def initialize_multihost(
     explicit = any(
         a is not None for a in (coordinator_address, num_processes, process_id)
     )
+    if explicit and _multihost_initialized and not _distributed_client_active():
+        # a prior no-arg call fell back to single-process; honoring an
+        # explicit cluster request now is impossible (the backend is up), and
+        # silently returning single-process info would break the "explicit
+        # request must not fall back" guarantee below
+        raise RuntimeError(
+            "initialize_multihost(coordinator_address=...) called after an "
+            "earlier call already fell back to single-process mode; pass the "
+            "cluster arguments on the FIRST call, before any JAX API"
+        )
     if not _multihost_initialized:
         kwargs = {}
         if coordinator_address is not None:

@@ -1,0 +1,91 @@
+"""Worker for the 2-process jax.distributed smoke test (test_multihost.py).
+
+Each process: joins the cluster via ``initialize_multihost`` (the reference's
+per-host TF_CONFIG slot, /root/reference/distributedExample/03:68-74), takes
+its host stripe of a seeded global batch via ``host_shard``, assembles global
+arrays, and runs one shard_map DP train step over the cross-process mesh.
+It then checks the updated params against a locally-computed single-process
+reference — i.e. the cross-process psum really did average the gradients.
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port>
+(launched by the test with JAX_PLATFORMS=cpu, 2 local CPU devices, and the
+axon sitecustomize OFF the path).
+"""
+
+import sys
+
+import numpy as np
+
+
+def main(process_id: int, num_processes: int, port: int) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.ops.accumulation import streaming_init, streaming_step
+    from gradaccum_tpu.parallel.dp import make_dp_train_step
+    from gradaccum_tpu.parallel.mesh import initialize_multihost, make_mesh
+    from gradaccum_tpu.parallel.sharding import batch_sharding, host_shard
+
+    info = initialize_multihost(
+        coordinator_address=f"localhost:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert info["process_count"] == num_processes, info
+    assert info["process_index"] == process_id, info
+    n_global = len(info["global_devices"])
+    n_local = len(info["local_devices"])
+    assert n_global == n_local * num_processes, info
+
+    mesh = make_mesh(data=n_global)
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    rng = np.random.default_rng(0)
+    B = 4 * n_global
+    x = rng.normal(size=(B, 3)).astype(np.float32)
+    y = (x @ np.asarray([[1.0], [-2.0], [0.5]], np.float32)).astype(np.float32)
+    global_batch = {"x": x, "y": y}
+    params = {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}
+
+    opt = gt.ops.adam(1e-2)
+    accum = gt.GradAccumConfig(num_micro_batches=2, first_step_quirk=False)
+
+    # this process's stripe -> global sharded arrays over the data axis
+    local = host_shard(global_batch)
+    sharding = batch_sharding(mesh)
+    batch = jax.tree.map(
+        lambda l: jax.make_array_from_process_local_data(sharding, l), local
+    )
+
+    # single-process reference on the full batch, computed BEFORE the DP
+    # step (which donates a state aliasing params): the updates must match
+    ref = jax.jit(streaming_step(loss_fn, opt, accum))
+    ref_state, ref_aux = ref(streaming_init(params, opt), global_batch)
+    ref_state = jax.device_get(ref_state)
+
+    step = make_dp_train_step(loss_fn, opt, accum, mesh, mode="streaming")
+    state, aux = step(streaming_init(params, opt), batch)
+    np.testing.assert_allclose(
+        float(jax.device_get(aux["loss"])),
+        float(jax.device_get(ref_aux["loss"])),
+        rtol=1e-5,
+    )
+    got = jax.device_get(state.params)
+    want = ref_state.params
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
+        got, want,
+    )
+    print(
+        f"MULTIHOST_OK process={process_id}/{num_processes} "
+        f"devices={n_global} loss={float(jax.device_get(aux['loss'])):.6f} "
+        f"w00={got['w'][0, 0]:.8f}"
+    )
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]))
