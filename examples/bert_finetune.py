@@ -73,9 +73,17 @@ def main(argv=None):
              "weights (the reference's BERT-Small checkpoint, README.md:66-72)",
     )
     parser.add_argument("--bf16", action="store_true", help="bfloat16 MXU compute")
+    parser.add_argument(
+        "--num-experts", type=int, default=0,
+        help="replace each FFN with a top-1-routed MoE expert bank "
+             "(expert parallelism via models/moe.py; 0 = dense)",
+    )
     parser.add_argument("--full", action="store_true",
                         help="reference scale: 3 epochs over the corpus")
     args = parser.parse_args(argv)
+    if args.hf_checkpoint and args.num_experts:
+        parser.error("--num-experts cannot combine with --hf-checkpoint "
+                     "(pretrained dense FFN weights have no expert bank)")
 
     import jax.numpy as jnp
     import numpy as np
@@ -141,6 +149,7 @@ def main(argv=None):
         cfg = BertConfig.small(
             vocab_size=max(len(tok.vocab), 128),
             dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+            num_experts=args.num_experts,
         )
     schedule = gt.warmup_polynomial_decay(
         args.lr, num_train_steps=max_steps,

@@ -50,6 +50,12 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     dtype: Any = jnp.float32
     remat: bool = False  # jax.checkpoint each encoder layer
+    # Mixture-of-Experts FFN (expert parallelism): 0 = dense FFN. When > 0,
+    # every layer's FFN becomes a top-1-routed expert bank (models/moe.py)
+    # and the classifier loss adds moe_aux_weight * load-balance loss.
+    num_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @staticmethod
     def small(**kw) -> "BertConfig":
@@ -110,6 +116,38 @@ class SelfAttention(nn.Module):
         return nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="output")(ctx)
 
 
+class MoEFFN(nn.Module):
+    """Expert-bank FFN slot for :class:`EncoderLayer` — parameters named to
+    match :func:`gradaccum_tpu.models.moe.moe_ep_rules` so the whole
+    TrainState shards over the ``expert`` mesh axis with no extra code. The
+    per-layer Switch load-balance loss is sown into the ``"losses"``
+    collection for the bundle's loss to pick up."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        import numpy as np
+
+        from gradaccum_tpu.models.moe import moe_apply
+
+        cfg = self.config
+        d, h, e = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
+        scale_in = 1.0 / np.sqrt(d)
+        scale_out = 1.0 / np.sqrt(h)
+        params = {
+            "router": self.param("router", nn.initializers.normal(scale_in), (d, e)),
+            "w_in": self.param("w_in", nn.initializers.normal(scale_in), (e, d, h)),
+            "b_in": self.param("b_in", nn.initializers.zeros, (e, h)),
+            "w_out": self.param("w_out", nn.initializers.normal(scale_out), (e, h, d)),
+            "b_out": self.param("b_out", nn.initializers.zeros, (e, d)),
+        }
+        params = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+        y, aux = moe_apply(params, x, cfg.moe_capacity_factor)
+        self.sow("losses", "load_balance", aux["load_balance_loss"])
+        return y
+
+
 class EncoderLayer(nn.Module):
     config: BertConfig
     attention_fn: Callable = dense_attention
@@ -124,9 +162,12 @@ class EncoderLayer(nn.Module):
         # post-LN (original BERT): LN(x + sublayer(x))
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="attention_LayerNorm")(x + attn_out)
-        ffn = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="intermediate")(x)
-        ffn = nn.gelu(ffn, approximate=False)
-        ffn = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="ffn_output")(ffn)
+        if cfg.num_experts > 0:
+            ffn = MoEFFN(cfg, name="moe")(x)
+        else:
+            ffn = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="intermediate")(x)
+            ffn = nn.gelu(ffn, approximate=False)
+            ffn = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="ffn_output")(ffn)
         ffn = nn.Dropout(cfg.hidden_dropout)(ffn, deterministic=deterministic)
         return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                             name="output_LayerNorm")(x + ffn)
@@ -251,34 +292,46 @@ def bert_classifier_bundle(
     )
 
     def init(rng, sample):
-        return init_model.init(
+        variables = init_model.init(
             {"params": rng, "dropout": rng},
             sample["input_ids"],
             sample.get("input_mask"),
             sample.get("segment_ids"),
             True,
         )
+        # keep only trainables: MoE layers also sow a "losses" collection at
+        # init, which must not leak into the optimizer state
+        return {"params": variables["params"]}
+
+    moe = config.num_experts > 0
+
+    def _apply(params, batch, deterministic, rngs=None):
+        args = (
+            batch["input_ids"],
+            batch.get("input_mask"),
+            batch.get("segment_ids"),
+            deterministic,
+        )
+        if not moe:
+            return model.apply(params, *args, rngs=rngs), 0.0
+        # MoE layers sow their Switch load-balance terms into "losses"
+        logits, mutated = model.apply(
+            params, *args, rngs=rngs, mutable=["losses"]
+        )
+        terms = jax.tree.leaves(mutated["losses"])
+        aux = sum(terms) / len(terms)
+        return logits, aux
 
     def loss(params, batch):
-        logits = model.apply(
-            params,
-            batch["input_ids"],
-            batch.get("input_mask"),
-            batch.get("segment_ids"),
-            False,
-            rngs={"dropout": batch["rng"]},
+        logits, moe_aux = _apply(
+            params, batch, False, rngs={"dropout": batch["rng"]}
         )
         onehot = jax.nn.one_hot(batch["label"], num_classes)
-        return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+        ce = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+        return ce + config.moe_aux_weight * moe_aux
 
     def predict(params, batch):
-        logits = model.apply(
-            params,
-            batch["input_ids"],
-            batch.get("input_mask"),
-            batch.get("segment_ids"),
-            True,
-        )
+        logits, _ = _apply(params, batch, True)
         return {
             "logits": logits,
             "classes": jnp.argmax(logits, axis=-1),

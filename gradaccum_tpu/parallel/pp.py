@@ -108,6 +108,7 @@ def make_pp_train_step(
     num_micro_batches: int,
     mesh: Mesh,
     axis: str = PIPE_AXIS,
+    data_axis: str | None = None,
     input_key: str = "x",
 ):
     """Build ``train_step(state, batch) -> (state, aux)``.
@@ -116,6 +117,12 @@ def make_pp_train_step(
     (use ``stack_micro_batches``); the remaining leaves (labels) are passed
     per-micro-batch to ``loss_fn``. State/params are stage-stacked; the
     returned step is jitted with state donated.
+
+    With ``data_axis`` set (a ``(pipe, data)`` mesh), the micro-batch dim is
+    sharded over ``data``: each data rank pipelines its own shard and the
+    stage gradients are ``pmean``-ed across ``data`` before the update —
+    GPipe × the reference's mirrored-worker DP (distributedExample/04:106)
+    in one step function.
     """
     k = num_micro_batches
 
@@ -132,7 +139,14 @@ def make_pp_train_step(
             )(outs, labels)
             local = jnp.mean(losses)
             # only the last rank saw real outputs; broadcast its loss
-            return lax.psum(jnp.where(idx == n - 1, local, 0.0), axis)
+            pipe_loss = lax.psum(jnp.where(idx == n - 1, local, 0.0), axis)
+            if data_axis is None:
+                return pipe_loss
+            # global-mean loss INSIDE the differentiated function: autodiff's
+            # transpose then yields the cross-replica mean gradient directly
+            # (shard_map's vma-aware transpose already psums cotangents onto
+            # data-replicated params — a post-hoc pmean would double-count)
+            return lax.pmean(pipe_loss, data_axis)
 
         loss, local_grads = jax.value_and_grad(fwd)(local_params)
         # re-stack to the [1, ...] local slice of the stage-stacked layout
@@ -148,18 +162,35 @@ def make_pp_train_step(
 
     n_stages = dict(mesh.shape)[axis]
 
-    def leaf_spec(leaf):
-        # stage-stacked leaves carry the [P, ...] leading dim; anything else
-        # (e.g. a bias-corrected Adam's scalar step counter) is replicated
-        stacked = getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n_stages
-        return P(axis) if stacked else P()
-
     def state_specs(state):
+        """Structural spec derivation — NOT a shape heuristic. The opt state
+        of the stacked params is compared leaf-by-leaf against the shapes
+        ``optimizer.init`` produces for ONE stage (via ``eval_shape``, so
+        nothing is computed): a leaf is stage-stacked iff its shape is
+        exactly ``(P,) + single_stage_shape``. A replicated leaf that merely
+        happens to have leading dim P (e.g. a length-P schedule table) keeps
+        its single-stage shape under init and is correctly replicated."""
+        single_params = jax.tree.map(
+            lambda p: jax.ShapeDtypeStruct(p.shape[1:], p.dtype), state.params
+        )
+        single_opt = jax.eval_shape(optimizer.init, single_params)
+
+        def opt_spec(leaf, single_leaf):
+            stacked = tuple(leaf.shape) == (n_stages,) + tuple(single_leaf.shape)
+            return P(axis) if stacked else P()
+
         return PPState(
-            params=jax.tree.map(leaf_spec, state.params),
-            opt_state=jax.tree.map(leaf_spec, state.opt_state),
+            params=jax.tree.map(lambda _: P(axis), state.params),
+            opt_state=jax.tree.map(opt_spec, state.opt_state, single_opt),
             step=P(),
         )
+
+    def batch_leaf_spec(leaf):
+        # [K, B, ...] leaves shard the micro-batch dim over data; rank-1 [K]
+        # leaves (per-micro-batch scalars like loss weights) are replicated
+        if data_axis is not None and getattr(leaf, "ndim", 0) >= 2:
+            return P(None, data_axis)
+        return P()
 
     jitted = {}
 
@@ -171,9 +202,19 @@ def make_pp_train_step(
                 f"built with num_micro_batches={k}; the step counter and LR "
                 "schedule would silently desync"
             )
+        if data_axis is not None:
+            b = batch[input_key].shape[1]
+            for name, leaf in batch.items():
+                if getattr(leaf, "ndim", 0) >= 2 and leaf.shape[1] != b:
+                    raise ValueError(
+                        f"batch[{name!r}] has dim-1 {leaf.shape[1]} but the "
+                        f"{input_key!r} micro-batch dim is {b}; rank>=2 leaves "
+                        "must be [K, B, ...] batch-major to shard over "
+                        f"{data_axis!r} (pass per-micro scalars as rank-1 [K])"
+                    )
         key = tuple(sorted(batch))
         if key not in jitted:
-            in_specs = (state_specs(state), jax.tree.map(lambda _: P(), batch))
+            in_specs = (state_specs(state), jax.tree.map(batch_leaf_spec, batch))
             jitted[key] = jax.jit(
                 jax.shard_map(
                     step, mesh=mesh, in_specs=in_specs,

@@ -137,3 +137,96 @@ def test_pp_training_descends(rng):
         state, aux = step(state, batch)
         losses.append(float(aux["loss"]))
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+@pytest.mark.parametrize("n_stages,dp,k", [(2, 4, 4), (4, 2, 4), (2, 2, 6)])
+def test_dp_pp_step_matches_sequential(rng, n_stages, dp, k):
+    """(pipe, data) composition: batch sharded over data, stage grads
+    pmean'd across replicas — must equal the sequential full-batch update."""
+    mesh = make_mesh(pipe=n_stages, data=dp, devices=jax.devices()[: n_stages * dp])
+    stages = make_stages(rng, n_stages)
+    batch = _batch(rng, k)
+    opt = adamw(1e-3, weight_decay_rate=0.01)
+
+    ref_loss, ref_params = _sequential_reference(stages, batch, opt, k)
+
+    step = make_pp_train_step(stage_fn, loss_fn, opt, k, mesh, data_axis="data")
+    state, aux = step(pp_init(stages, opt), batch)
+
+    np.testing.assert_allclose(float(aux["loss"]), float(ref_loss), rtol=1e-5)
+    # sharded-mean gradients differ from the global mean only by float
+    # reassociation (~1e-7), but first-step Adam (v ~= g^2, no bias
+    # correction) amplifies that near eps — hence the looser tolerance here;
+    # the SGD variant below pins the gradients themselves tightly
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=2e-5
+        ),
+        jax.device_get(state.params),
+        jax.device_get(ref_params),
+    )
+    assert int(state.step) == k
+
+
+def test_dp_pp_sgd_gradients_match_tightly(rng):
+    """With SGD the params delta IS the (lr-scaled) gradient: dp×pp must
+    reproduce the sequential gradient to float-reassociation precision."""
+    n_stages, dp, k = 2, 4, 4
+    mesh = make_mesh(pipe=n_stages, data=dp, devices=jax.devices()[: n_stages * dp])
+    stages = make_stages(rng, n_stages)
+    batch = _batch(rng, k)
+    opt = sgd(0.5)
+
+    ref_loss, ref_params = _sequential_reference(stages, batch, opt, k)
+    step = make_pp_train_step(stage_fn, loss_fn, opt, k, mesh, data_axis="data")
+    state, aux = step(pp_init(stages, opt), batch)
+
+    np.testing.assert_allclose(float(aux["loss"]), float(ref_loss), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        jax.device_get(state.params),
+        jax.device_get(ref_params),
+    )
+
+
+def test_pp_replicated_length_p_opt_leaf_not_sharded(rng):
+    """Regression for the round-1 heuristic: an optimizer whose state carries
+    a REPLICATED length-P table (shape coincides with the stage count) must
+    not get sharded over the pipe axis. The structural spec derivation keys
+    off eval_shape(optimizer.init), not leaf.shape[0]."""
+    from gradaccum_tpu.ops.adamw import Optimizer
+
+    n_stages, k = 4, 4
+    mesh = make_mesh(pipe=n_stages, devices=jax.devices()[:n_stages])
+    stages = make_stages(rng, n_stages)
+    batch = _batch(rng, k)
+
+    table = jnp.linspace(0.2, 0.2, n_stages)  # constant lr table, len == P
+
+    def init(params):
+        return {"table": table}
+
+    def update(grads, opt_state, params, step):
+        lr = opt_state["table"][0]
+        new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return new_params, opt_state
+
+    opt = Optimizer(init=init, update=update)
+    sgd_ref = sgd(0.2)
+    _, ref_params = _sequential_reference(stages, batch, sgd_ref, k)
+
+    step = make_pp_train_step(stage_fn, loss_fn, opt, k, mesh)
+    state, aux = step(pp_init(stages, opt), batch)
+
+    # the table survived replicated (full length on the host view) and the
+    # update matches plain SGD at the same lr
+    assert jax.device_get(state.opt_state["table"]).shape == (n_stages,)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        jax.device_get(state.params),
+        jax.device_get(ref_params),
+    )
