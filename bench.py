@@ -105,17 +105,42 @@ def measure(iters, warmup):
     stacked = gt.stack_micro_batches(batch, K)
     key = jax.random.PRNGKey(1)
 
-    for _ in range(warmup):
-        state, aux = step(state, stacked, key)
-    jax.block_until_ready(aux["loss"])
+    # Force completion with a HOST READBACK of the loss and the smallest
+    # param leaf (covers the full fwd+bwd+AdamW chain of the last step).
+    # block_until_ready has been observed returning before the dispatched
+    # chain finishes on the tunneled axon backend — timing with it measured
+    # Python dispatch, not device compute (the round-1 ~35k seq/s artifact).
+    small_leaf = min(jax.tree.leaves(params), key=lambda l: l.size)
+    small_path = [i for i, l in enumerate(jax.tree.leaves(params))
+                  if l is small_leaf][0]
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, aux = step(state, stacked, key)
-    jax.block_until_ready(aux["loss"])
-    dt = time.perf_counter() - t0
+    def timed(n, state):
+        t0 = time.perf_counter()
+        aux = None
+        for _ in range(n):
+            state, aux = step(state, stacked, key)
+        float(jax.device_get(aux["loss"]))
+        np.asarray(jax.device_get(jax.tree.leaves(state.params)[small_path]))
+        return time.perf_counter() - t0, state
 
-    seqs_per_sec = iters * K * MICRO / dt
+    for _ in range(max(warmup, 1)):  # >=1: the drain below needs aux bound
+        state, aux = step(state, stacked, key)
+    float(jax.device_get(aux["loss"]))  # drain warmup
+
+    # Two-point timing cancels the constant per-measurement overhead (the
+    # tunnel's readback round-trip is ~90 ms, comparable to the compute for
+    # small iteration counts).
+    n_small = max(1, iters // 5)
+    dt_big, state = timed(iters, state)
+    if iters > n_small:
+        dt_small, state = timed(n_small, state)
+        per_step = (dt_big - dt_small) / (iters - n_small)
+    else:
+        per_step = dt_big / iters
+    if per_step <= 0:  # timing noise swamped the difference: fall back
+        per_step = dt_big / iters
+
+    seqs_per_sec = K * MICRO / per_step
     flops_per_seq = bert_train_flops_per_seq(
         cfg.hidden_size, cfg.num_layers, cfg.intermediate_size, SEQ, NUM_CLASSES
     )
@@ -167,9 +192,9 @@ def run_orchestrator():
     attempts = []
     plans = [
         # (extra_env, iters, warmup, timeout_s, label)
-        ({}, 30, 3, 900, "attempt-1"),
-        ({}, 30, 3, 900, "attempt-2"),
-        ({}, 30, 3, 900, "attempt-3"),
+        ({}, 200, 5, 900, "attempt-1"),
+        ({}, 200, 5, 900, "attempt-2"),
+        ({}, 200, 5, 900, "attempt-3"),
         ({"JAX_PLATFORMS": "cpu"}, 3, 1, 1800, "cpu-fallback"),
     ]
     backoff = [0, 30, 90, 10]
@@ -239,8 +264,8 @@ def run_orchestrator():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--worker", action="store_true")
-    ap.add_argument("--iters", type=int, default=30)
-    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--warmup", type=int, default=5)
     args = ap.parse_args()
     if args.worker:
         run_worker(args)

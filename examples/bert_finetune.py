@@ -47,15 +47,34 @@ def synthetic_text_task(num_examples: int, seed: int):
 
 
 def load_tsv(path):
+    """``label<TAB>...<TAB>text`` reader with loud malformed-row handling.
+
+    Rows that don't parse (too few columns, non-integer label) are skipped
+    with a warning that counts them; a file with no valid rows is an error
+    rather than an empty dataset that would fail later in training.
+    """
     import numpy as np
 
     texts, labels = [], []
+    skipped = 0
     with open(path) as f:
         for line in f:
             parts = line.rstrip("\n").split("\t")
-            if len(parts) >= 2:
-                labels.append(int(parts[0]))
-                texts.append(parts[-1])
+            if len(parts) < 2:
+                skipped += 1
+                continue
+            try:
+                label = int(parts[0])
+            except ValueError:
+                skipped += 1
+                continue
+            labels.append(label)
+            texts.append(parts[-1])
+    if skipped:
+        print(f"[warn] {path}: skipped {skipped} malformed row(s) "
+              f"({len(texts)} kept)", file=sys.stderr)
+    if not texts:
+        raise ValueError(f"{path}: no parseable 'label<TAB>text' rows")
     return texts, np.asarray(labels, np.int32)
 
 
@@ -80,6 +99,11 @@ def main(argv=None):
     )
     parser.add_argument("--full", action="store_true",
                         help="reference scale: 3 epochs over the corpus")
+    parser.add_argument(
+        "--accum-k", type=int, default=None,
+        help="override the task's accumulation multiplier (1 = no "
+             "accumulation — the reference's Loss_Step.png baseline arm)",
+    )
     args = parser.parse_args(argv)
     if args.hf_checkpoint and args.num_experts:
         parser.error("--num-experts cannot combine with --hf-checkpoint "
@@ -124,7 +148,7 @@ def main(argv=None):
     )
 
     micro = t["batch"]
-    k = t["k"]
+    k = args.accum_k if args.accum_k is not None else t["k"]
     if args.full:
         # 3 epochs in micro-batch steps (README.md:75's formula)
         max_steps = len(train_labels) * 3 // micro

@@ -27,6 +27,7 @@ constexpr int kErrOpen = -1;
 constexpr int kErrMagic = -2;
 constexpr int kErrShort = -3;
 constexpr int kErrSize = -4;
+constexpr int kErrParse = -5;
 
 constexpr int32_t kImageMagic = 2051;
 constexpr int32_t kLabelMagic = 2049;
@@ -156,8 +157,11 @@ int ga_csv_size(const char* path, int skip_header, int32_t* n_rows,
   return 0;
 }
 
-// Fill out[n_rows*n_cols] row-major. Unparseable or empty fields become 0.0f
-// (tf.decode_csv record_defaults semantics, another-example.py:64-68).
+// Fill out[n_rows*n_cols] row-major. Only EMPTY fields default to 0.0f
+// (tf.decode_csv record_defaults semantics, another-example.py:64-68); a
+// non-empty field must parse in full or the read fails with kErrParse —
+// the same contract as the Python fallback's float(v) (csv.py), so the two
+// paths agree on malformed input instead of silently coercing prefixes.
 // Rows with a different column count than the first row are an error.
 int ga_csv_read(const char* path, int skip_header, float* out, int64_t len) {
   std::vector<unsigned char> data;
@@ -184,9 +188,17 @@ int ga_csv_read(const char* path, int skip_header, float* out, int64_t len) {
           size_t comma = line.find(',', start);
           size_t field_end = comma == std::string::npos ? line.size() : comma;
           std::string field = line.substr(start, field_end - start);
-          char* endptr = nullptr;
-          float value = std::strtof(field.c_str(), &endptr);
-          if (endptr == field.c_str()) value = 0.0f;  // record_defaults
+          // float(v) in the Python path strips surrounding whitespace; do the
+          // same so both paths see the identical token
+          size_t b = field.find_first_not_of(" \t");
+          size_t e = field.find_last_not_of(" \t");
+          field = b == std::string::npos ? "" : field.substr(b, e - b + 1);
+          float value = 0.0f;  // record_defaults: empty field -> 0.0
+          if (!field.empty()) {
+            char* endptr = nullptr;
+            value = std::strtof(field.c_str(), &endptr);
+            if (endptr != field.c_str() + field.size()) return kErrParse;
+          }
           if (written >= len) return kErrSize;
           out[written++] = value;
           ++c;

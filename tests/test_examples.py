@@ -1,0 +1,55 @@
+"""Fixture tests for the examples' real-data branches.
+
+The example entrypoints default to synthetic data in this zero-egress
+container, so their real-file code paths (tsv reading for BERT, idx/CSV
+handled in test_data/test_native) need fixture-driven coverage of their
+own — especially the malformed-input behavior, which must be loud, not a
+silent row drop."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from examples.bert_finetune import load_tsv, synthetic_text_task
+
+
+def test_load_tsv_well_formed(tmp_path):
+    p = tmp_path / "train.tsv"
+    p.write_text("1\tid1\tthe cat sat\n0\tid2\tsat cat the\n")
+    texts, labels = load_tsv(str(p))
+    assert texts == ["the cat sat", "sat cat the"]
+    np.testing.assert_array_equal(labels, [1, 0])
+    assert labels.dtype == np.int32
+
+
+def test_load_tsv_malformed_rows_warn_not_silent(tmp_path, capsys):
+    p = tmp_path / "train.tsv"
+    p.write_text(
+        "label\tsentence\n"      # header: non-integer label
+        "1\tgood row\n"
+        "loneword\n"             # too few columns
+        "0\tanother good row\n"
+    )
+    texts, labels = load_tsv(str(p))
+    assert texts == ["good row", "another good row"]
+    np.testing.assert_array_equal(labels, [1, 0])
+    err = capsys.readouterr().err
+    assert "skipped 2 malformed row(s)" in err
+
+
+def test_load_tsv_all_malformed_raises(tmp_path):
+    p = tmp_path / "empty.tsv"
+    p.write_text("not_a_label\ttext\nsingle-column row\n")
+    with pytest.raises(ValueError, match="no parseable"):
+        load_tsv(str(p))
+
+
+def test_synthetic_text_task_label_correlated():
+    texts, labels = synthetic_text_task(64, seed=3)
+    assert len(texts) == 64 and labels.shape == (64,)
+    t2, l2 = synthetic_text_task(64, seed=3)
+    assert texts == t2 and (labels == l2).all()  # deterministic

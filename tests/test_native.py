@@ -128,6 +128,39 @@ def test_csv_ragged_row_falls_back_to_python(tmp_path):
     np.testing.assert_allclose(out["c"], [3.0, 0.0])
 
 
+def test_csv_malformed_field_matches_python_semantics(tmp_path, monkeypatch):
+    """A non-empty, non-numeric field must NOT silently coerce: the native
+    parser errors (no strtof prefix acceptance), read_csv falls back to the
+    csv-module path, and that path raises — identical outcome with or
+    without the native library."""
+    columns = ["a", "b"]
+    path = str(tmp_path / "malformed.csv")
+    with open(path, "w") as f:
+        f.write("a,b\n1.5abc,2\n")  # numeric prefix, then garbage
+
+    with pytest.raises(ValueError):
+        native.read_csv_numeric(path, skip_header=True)
+    with pytest.raises(ValueError):
+        csv_lib.read_csv(path, columns=columns)
+    monkeypatch.setenv("GRADACCUM_NATIVE", "0")
+    with pytest.raises(ValueError):
+        csv_lib.read_csv(path, columns=columns)
+
+
+def test_csv_whitespace_and_specials_match_python(tmp_path):
+    """Whitespace-padded numbers and nan/inf parse the same as float(v);
+    whitespace-only fields are empty -> record_defaults 0.0."""
+    path = str(tmp_path / "ws.csv")
+    with open(path, "w") as f:
+        f.write("a,b,c\n 1.5 ,nan, \n-2e3,inf,7\n")
+    out = native.read_csv_numeric(path, skip_header=True)
+    assert out is not None
+    matrix, n_cols = out
+    assert n_cols == 3
+    assert matrix[0, 0] == 1.5 and np.isnan(matrix[0, 1]) and matrix[0, 2] == 0.0
+    assert matrix[1, 0] == -2000.0 and np.isinf(matrix[1, 1]) and matrix[1, 2] == 7.0
+
+
 def test_csv_crlf_and_no_trailing_newline(tmp_path):
     path = str(tmp_path / "crlf.csv")
     with open(path, "wb") as f:
