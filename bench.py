@@ -66,6 +66,15 @@ def measure(iters, warmup):
     honor_cpu_platform_request()
 
     import jax
+
+    # TPU-first: XLA's hardware RNG for dropout masks instead of the default
+    # threefry (which costs ~25% of this step: masks are ~8M random bits per
+    # micro-batch). Same Bernoulli dropout, different stream — the standard
+    # TPU training configuration. GRADACCUM_PRNG=threefry2x32 restores the
+    # default.
+    jax.config.update(
+        "jax_default_prng_impl", os.environ.get("GRADACCUM_PRNG", "rbg")
+    )
     import jax.numpy as jnp
     import numpy as np
 

@@ -104,6 +104,14 @@ def main(argv=None):
         help="override the task's accumulation multiplier (1 = no "
              "accumulation — the reference's Loss_Step.png baseline arm)",
     )
+    parser.add_argument(
+        "--label-noise", type=float, default=0.0,
+        help="flip this fraction of TRAIN labels (deterministic). Keeps the "
+             "loss floored above zero so per-batch gradient noise is visible "
+             "— the property the reference's Loss_Step.png comparison shows; "
+             "the synthetic task is otherwise separable and both arms "
+             "converge to ~0",
+    )
     args = parser.parse_args(argv)
     if args.hf_checkpoint and args.num_experts:
         parser.error("--num-experts cannot combine with --hf-checkpoint "
@@ -125,6 +133,10 @@ def main(argv=None):
     else:
         train_texts, train_labels = synthetic_text_task(t["num_train"], seed=1)
         eval_texts, eval_labels = synthetic_text_task(t["num_eval"], seed=2)
+    if args.label_noise > 0:
+        flip_rng = np.random.default_rng(19830610)
+        flip = flip_rng.random(len(train_labels)) < args.label_noise
+        train_labels = np.where(flip, 1 - train_labels, train_labels)
 
     vocab_path = args.vocab
     if args.hf_checkpoint and not vocab_path:

@@ -18,7 +18,10 @@ import sys
 
 
 def read_curve(model_dir):
-    path = os.path.join(model_dir, "loss_vs_step.csv")
+    return read_curve_file(os.path.join(model_dir, "loss_vs_step.csv"))
+
+
+def read_curve_file(path):
     steps, losses = [], []
     with open(path) as f:
         for row in csv.DictReader(f):

@@ -29,9 +29,11 @@ import re
 import shutil
 import subprocess
 import sys
+import tempfile
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
 
 # (name, script args, reference step count)
 MNIST_RUNS = [
@@ -41,12 +43,22 @@ MNIST_RUNS = [
     ("mnist_04_2w_b50_k2", ["--variant", "04", "--max-steps", "3000"]),
 ]
 BERT_RUNS = [
-    ("bert_cola_k4_eff32", ["--task", "cola", "--accum-k", "4", "--max-steps", "1600"]),
-    ("bert_cola_k1_eff8", ["--task", "cola", "--accum-k", "1", "--max-steps", "1600"]),
+    ("bert_cola_k4_eff32",
+     ["--task", "cola", "--accum-k", "4", "--max-steps", "3200",
+      "--label-noise", "0.15"]),
+    ("bert_cola_k1_eff8",
+     ["--task", "cola", "--accum-k", "1", "--max-steps", "3200",
+      "--label-noise", "0.15"]),
 ]
+HOUSING_RUN = ("housing_b59_k3", ["--max-steps", "3000"])
 
 
-def run_one(script, name, extra, run_root, quick):
+def run_one(script, name, extra, run_root, quick, cpu_mesh=True):
+    """``cpu_mesh``: force the 8-device virtual CPU mesh (required for the
+    2-worker MNIST variants). With False the run inherits the ambient
+    platform — the real TPU chip when one is attached, CPU otherwise —
+    which is how the single-device BERT arms mirror the reference's
+    single-GPU setup."""
     model_dir = str(run_root / name)
     cmd = [sys.executable, str(REPO / "examples" / script),
            "--model-dir", model_dir] + extra
@@ -54,25 +66,36 @@ def run_one(script, name, extra, run_root, quick):
         # keep the matrix shape but cut steps 10x for smoke runs
         i = cmd.index("--max-steps")
         cmd[i + 1] = str(max(int(cmd[i + 1]) // 10, 20))
-    env = dict(
-        os.environ,
-        JAX_PLATFORMS="cpu",
-        XLA_FLAGS="--xla_force_host_platform_device_count=8",
-    )
+    env = dict(os.environ)
+    if cpu_mesh:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     print(f"[run] {name}: {' '.join(cmd[1:])}", flush=True)
-    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                          cwd=str(REPO))
+    proc = None
+    for attempt in range(3):  # the axon TPU tunnel can hang at backend init
+        try:
+            proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                                  cwd=str(REPO), timeout=1800)
+            break
+        except subprocess.TimeoutExpired:
+            print(f"[run] {name}: attempt {attempt + 1} timed out, retrying",
+                  flush=True)
+    if proc is None:
+        raise RuntimeError(f"{name}: all attempts timed out")
     tail = "\n".join(proc.stdout.strip().splitlines()[-3:])
     print(tail, flush=True)
     if proc.returncode != 0:
         sys.stderr.write(proc.stderr[-2000:])
         raise RuntimeError(f"{name} failed (rc={proc.returncode})")
-    m = re.search(r"final accuracy ([0-9.]+)|eval accuracy ([0-9.]+)", proc.stdout)
+    m = re.search(
+        r"final accuracy ([0-9.]+)|eval accuracy ([0-9.]+)|Test RMSE: ([0-9.]+)",
+        proc.stdout,
+    )
     acc = float(next(g for g in m.groups() if g)) if m else None
     return model_dir, acc
 
 
-from examples.plot_loss import read_curve  # noqa: E402  (same CSV contract)
+from examples.plot_loss import read_curve, read_curve_file  # noqa: E402
 
 
 def tail_mean(losses, frac=0.1):
@@ -111,45 +134,73 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default=str(REPO / "results"))
     ap.add_argument("--quick", action="store_true", help="10x fewer steps (smoke)")
+    ap.add_argument(
+        "--only", choices=["all", "mnist", "bert", "housing"], default="all",
+        help="rerun one group; other groups' curves reload from --out",
+    )
     args = ap.parse_args(argv)
 
     out = Path(args.out)
     out.mkdir(parents=True, exist_ok=True)
-    run_root = Path("/tmp/gradaccum_results_runs")
-    if run_root.exists():
-        shutil.rmtree(run_root)
-    run_root.mkdir(parents=True)
+    # per-invocation scratch dir: concurrent invocations (e.g. a CPU mnist
+    # sweep alongside a TPU bert sweep) must not clobber each other
+    run_root = Path(tempfile.mkdtemp(prefix="gradaccum_results_"))
 
+    # merge into the existing summary so an --only rerun of one group never
+    # wipes the other groups' measured numbers
     summary = {"quick": args.quick, "runs": {}}
+    summary_path = out / "summary.json"
+    if summary_path.exists():
+        with open(summary_path) as f:
+            summary["runs"] = json.load(f).get("runs", {})
+
     mnist_curves, bert_curves = {}, {}
 
-    for name, extra in MNIST_RUNS:
-        model_dir, acc = run_one("mnist.py", name, extra, run_root, args.quick)
-        steps, losses = read_curve(model_dir)
-        mnist_curves[name] = (steps, losses)
-        shutil.copy(os.path.join(model_dir, "loss_vs_step.csv"),
-                    out / f"{name}.csv")
-        summary["runs"][name] = {
-            "final_accuracy": acc,
-            "steps": steps[-1],
-            "tail_loss_mean": round(tail_mean(losses), 4),
-        }
+    import numpy as np
 
-    for name, extra in BERT_RUNS:
-        model_dir, acc = run_one("bert_finetune.py", name, extra, run_root,
-                                 args.quick)
-        steps, losses = read_curve(model_dir)
-        bert_curves[name] = (steps, losses)
-        shutil.copy(os.path.join(model_dir, "loss_vs_step.csv"),
-                    out / f"{name}.csv")
+    def record(name, curves, steps, losses, acc=None, reloaded=False,
+               metric_key="final_accuracy"):
+        if curves is not None:
+            curves[name] = (steps, losses)
+        if reloaded and name in summary["runs"]:
+            return  # keep the previously measured entry verbatim
         summary["runs"][name] = {
-            "final_accuracy": acc,
+            metric_key: acc,
             "steps": steps[-1],
             "tail_loss_mean": round(tail_mean(losses), 4),
             "tail_loss_std": round(
-                float(__import__("numpy").std(
-                    losses[-max(1, len(losses) // 10):])), 4),
+                float(np.std(losses[-max(1, len(losses) // 10):])), 4),
         }
+
+    for name, extra in MNIST_RUNS:
+        if args.only not in ("all", "mnist"):
+            record(name, mnist_curves, *read_curve_file(out / f"{name}.csv"),
+                   reloaded=True)
+            continue
+        model_dir, acc = run_one("mnist.py", name, extra, run_root, args.quick)
+        shutil.copy(os.path.join(model_dir, "loss_vs_step.csv"),
+                    out / f"{name}.csv")
+        record(name, mnist_curves, *read_curve(model_dir), acc=acc)
+
+    for name, extra in BERT_RUNS:
+        if args.only not in ("all", "bert"):
+            record(name, bert_curves, *read_curve_file(out / f"{name}.csv"),
+                   reloaded=True)
+            continue
+        model_dir, acc = run_one("bert_finetune.py", name, extra, run_root,
+                                 args.quick, cpu_mesh=False)
+        shutil.copy(os.path.join(model_dir, "loss_vs_step.csv"),
+                    out / f"{name}.csv")
+        record(name, bert_curves, *read_curve(model_dir), acc=acc)
+
+    if args.only in ("all", "housing"):
+        name, extra = HOUSING_RUN
+        model_dir, rmse = run_one("housing.py", name, extra, run_root,
+                                  args.quick)
+        shutil.copy(os.path.join(model_dir, "loss_vs_step.csv"),
+                    out / f"{name}.csv")
+        record(name, None, *read_curve(model_dir), acc=rmse,
+               metric_key="final_test_rmse")
 
     overlay(out / "mnist_matrix.png", mnist_curves,
             "MNIST effective-batch-200 matrix (reference Loss_Step_multiWorker.png)")

@@ -71,8 +71,11 @@ def read_csv(
         if name in HOUSING_CATEGORICAL:
             out[name] = np.asarray(raw, dtype=object)
         else:
+            # whitespace-only counts as empty -> record_defaults 0.0, same as
+            # the native parser's trim; non-empty fields must parse in full
+            stripped = [("" if v is None else str(v).strip()) for v in raw]
             out[name] = np.asarray(
-                [float(v) if v not in ("", None) else 0.0 for v in raw],
+                [float(v) if v else 0.0 for v in stripped],
                 dtype=np.float32,
             )
     return out

@@ -195,6 +195,11 @@ int ga_csv_read(const char* path, int skip_header, float* out, int64_t len) {
           field = b == std::string::npos ? "" : field.substr(b, e - b + 1);
           float value = 0.0f;  // record_defaults: empty field -> 0.0
           if (!field.empty()) {
+            // strtof accepts hex floats ("0x1A") but Python's float() does
+            // not; reject them so both paths agree
+            if (field.find('x') != std::string::npos ||
+                field.find('X') != std::string::npos)
+              return kErrParse;
             char* endptr = nullptr;
             value = std::strtof(field.c_str(), &endptr);
             if (endptr != field.c_str() + field.size()) return kErrParse;

@@ -147,9 +147,10 @@ def test_csv_malformed_field_matches_python_semantics(tmp_path, monkeypatch):
         csv_lib.read_csv(path, columns=columns)
 
 
-def test_csv_whitespace_and_specials_match_python(tmp_path):
+def test_csv_whitespace_and_specials_match_python(tmp_path, monkeypatch):
     """Whitespace-padded numbers and nan/inf parse the same as float(v);
-    whitespace-only fields are empty -> record_defaults 0.0."""
+    whitespace-only fields are empty -> record_defaults 0.0 — on BOTH the
+    native and Python paths."""
     path = str(tmp_path / "ws.csv")
     with open(path, "w") as f:
         f.write("a,b,c\n 1.5 ,nan, \n-2e3,inf,7\n")
@@ -159,6 +160,24 @@ def test_csv_whitespace_and_specials_match_python(tmp_path):
     assert n_cols == 3
     assert matrix[0, 0] == 1.5 and np.isnan(matrix[0, 1]) and matrix[0, 2] == 0.0
     assert matrix[1, 0] == -2000.0 and np.isinf(matrix[1, 1]) and matrix[1, 2] == 7.0
+
+    monkeypatch.setenv("GRADACCUM_NATIVE", "0")
+    got = csv_lib.read_csv(path, columns=["a", "b", "c"])
+    np.testing.assert_array_equal(got["a"], matrix[:, 0])
+    assert np.isnan(got["b"][0]) and np.isinf(got["b"][1])
+    np.testing.assert_array_equal(got["c"], matrix[:, 2])
+
+
+def test_csv_hex_floats_rejected_like_python(tmp_path):
+    """strtof accepts '0x1A'; float() does not — native must error so the
+    csv-module fallback (which raises) decides, identically on both paths."""
+    path = str(tmp_path / "hex.csv")
+    with open(path, "w") as f:
+        f.write("a,b\n0x1A,2\n")
+    with pytest.raises(ValueError):
+        native.read_csv_numeric(path, skip_header=True)
+    with pytest.raises(ValueError):
+        csv_lib.read_csv(path, columns=["a", "b"])
 
 
 def test_csv_crlf_and_no_trailing_newline(tmp_path):
