@@ -65,16 +65,11 @@ def measure(iters, warmup):
 
     honor_cpu_platform_request()
 
-    import jax
+    from gradaccum_tpu.utils.timing import configure_fast_prng, time_device_steps
 
-    # TPU-first: XLA's hardware RNG for dropout masks instead of the default
-    # threefry (which costs ~25% of this step: masks are ~8M random bits per
-    # micro-batch). Same Bernoulli dropout, different stream — the standard
-    # TPU training configuration. GRADACCUM_PRNG=threefry2x32 restores the
-    # default.
-    jax.config.update(
-        "jax_default_prng_impl", os.environ.get("GRADACCUM_PRNG", "rbg")
-    )
+    configure_fast_prng()
+
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
@@ -114,40 +109,13 @@ def measure(iters, warmup):
     stacked = gt.stack_micro_batches(batch, K)
     key = jax.random.PRNGKey(1)
 
-    # Force completion with a HOST READBACK of the loss and the smallest
-    # param leaf (covers the full fwd+bwd+AdamW chain of the last step).
-    # block_until_ready has been observed returning before the dispatched
-    # chain finishes on the tunneled axon backend — timing with it measured
-    # Python dispatch, not device compute (the round-1 ~35k seq/s artifact).
-    small_leaf = min(jax.tree.leaves(params), key=lambda l: l.size)
-    small_path = [i for i, l in enumerate(jax.tree.leaves(params))
-                  if l is small_leaf][0]
-
-    def timed(n, state):
-        t0 = time.perf_counter()
-        aux = None
-        for _ in range(n):
-            state, aux = step(state, stacked, key)
-        float(jax.device_get(aux["loss"]))
-        np.asarray(jax.device_get(jax.tree.leaves(state.params)[small_path]))
-        return time.perf_counter() - t0, state
-
     for _ in range(max(warmup, 1)):  # >=1: the drain below needs aux bound
         state, aux = step(state, stacked, key)
     float(jax.device_get(aux["loss"]))  # drain warmup
 
-    # Two-point timing cancels the constant per-measurement overhead (the
-    # tunnel's readback round-trip is ~90 ms, comparable to the compute for
-    # small iteration counts).
-    n_small = max(1, iters // 5)
-    dt_big, state = timed(iters, state)
-    if iters > n_small:
-        dt_small, state = timed(n_small, state)
-        per_step = (dt_big - dt_small) / (iters - n_small)
-    else:
-        per_step = dt_big / iters
-    if per_step <= 0:  # timing noise swamped the difference: fall back
-        per_step = dt_big / iters
+    # host-readback completion + two-point timing: see utils/timing.py for
+    # why block_until_ready cannot be trusted on the tunneled backend
+    per_step, state = time_device_steps(step, state, (stacked, key), iters)
 
     seqs_per_sec = K * MICRO / per_step
     flops_per_seq = bert_train_flops_per_seq(
@@ -204,9 +172,12 @@ def run_orchestrator():
         ({}, 200, 5, 900, "attempt-1"),
         ({}, 200, 5, 900, "attempt-2"),
         ({}, 200, 5, 900, "attempt-3"),
+        ({}, 200, 5, 900, "attempt-4"),
         ({"JAX_PLATFORMS": "cpu"}, 3, 1, 1800, "cpu-fallback"),
     ]
-    backoff = [0, 30, 90, 10]
+    # the tunnel has been observed down for tens of minutes at a stretch;
+    # spread the retries instead of burning them in the first two minutes
+    backoff = [0, 60, 300, 600, 10]
     cpu_only = False  # a probe proved this environment has no accelerator
     for (extra_env, iters, warmup, timeout_s, label), wait in zip(plans, backoff):
         wants_cpu = extra_env.get("JAX_PLATFORMS", "").startswith("cpu")

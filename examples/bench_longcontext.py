@@ -1,0 +1,148 @@
+"""Long-context attention scaling on one TPU chip: dense vs Pallas flash.
+
+The reference caps sequence length at a static 128 (--max_seq_length=128,
+/root/reference/README.md:72) — long context is one of this framework's
+beyond-reference capabilities, and this benchmark is its evidence. It trains
+BERT-Small (fwd+bwd+AdamW, bf16) across sequence lengths with the token
+count per step held constant, once with the dense [S,S] attention core and
+once with the fused online-softmax Pallas kernel
+(ops/flash_attention.py), optionally with per-layer rematerialization.
+
+Timing uses host readbacks + two-point measurement (see bench.py: the
+tunneled backend's block_until_ready can return early).
+
+Writes results/longcontext.csv and prints one JSON line per config.
+
+Usage: python examples/bench_longcontext.py [--out results/longcontext.csv]
+"""
+
+import argparse
+import csv
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+SEQS = [512, 1024, 2048, 4096, 8192]
+TOKENS_PER_STEP = 16384
+VOCAB = 30522
+
+
+def measure_one(seq, core, remat, iters, tokens_per_step=TOKENS_PER_STEP):
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.models.bert import (
+        BertConfig, bert_classifier_bundle, dense_attention,
+    )
+    from gradaccum_tpu.ops.accumulation import scan_init
+    from gradaccum_tpu.ops.flash_attention import flash_attention
+
+    micro = max(1, tokens_per_step // seq)
+    cfg = BertConfig.small(
+        vocab_size=VOCAB, dtype=jnp.bfloat16, remat=remat,
+        max_position_embeddings=max(512, seq),
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    attention_fn = flash_attention if core == "flash" else dense_attention
+    bundle = bert_classifier_bundle(cfg, num_classes=2,
+                                    attention_fn=attention_fn)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": rng.integers(0, VOCAB, size=(micro, seq)).astype(np.int32),
+        "input_mask": np.ones((micro, seq), np.int32),
+        "segment_ids": np.zeros((micro, seq), np.int32),
+        "label": rng.integers(0, 2, size=(micro,)).astype(np.int32),
+    }
+    params = bundle.init(jax.random.PRNGKey(0), batch)
+    opt = gt.ops.adamw(gt.warmup_polynomial_decay(2e-5, 10000, 1000),
+                       weight_decay_rate=0.01)
+    state = scan_init(params, opt)
+    step = jax.jit(
+        gt.accumulate_scan(
+            bundle.loss, opt, gt.GradAccumConfig(num_micro_batches=1),
+            needs_rng=True,
+        ),
+        donate_argnums=0,
+    )
+    stacked = gt.stack_micro_batches(batch, 1)
+    key = jax.random.PRNGKey(1)
+
+    for _ in range(3):
+        state, aux = step(state, stacked, key)
+    float(jax.device_get(aux["loss"]))
+
+    from gradaccum_tpu.utils.timing import time_device_steps
+
+    per_step, state = time_device_steps(step, state, (stacked, key), iters)
+    return {
+        "seq": seq,
+        "core": core,
+        "remat": remat,
+        "micro_batch": micro,
+        "ms_per_step": round(per_step * 1e3, 3),
+        "tokens_per_sec": round(micro * seq / per_step, 1),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=str(
+        Path(__file__).resolve().parent.parent / "results" / "longcontext.csv"))
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--seqs", type=int, nargs="*", default=SEQS)
+    ap.add_argument("--tokens", type=int, default=TOKENS_PER_STEP,
+                    help="tokens per step (micro_batch = tokens // seq)")
+    args = ap.parse_args(argv)
+
+    from gradaccum_tpu.utils.timing import configure_fast_prng
+
+    configure_fast_prng()
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"[longctx] device: {dev.device_kind} ({dev.platform})",
+          file=sys.stderr)
+
+    rows = []
+    # remat only matters once activations dominate HBM; measure it at the
+    # two longest requested lengths
+    remat_cutoff = sorted(args.seqs)[-2] if len(args.seqs) > 1 else args.seqs[0]
+    for seq in args.seqs:
+        for core in ("dense", "flash"):
+            for remat in ([False, True] if seq >= remat_cutoff else [False]):
+                label = f"seq={seq} core={core} remat={remat}"
+                try:
+                    row = measure_one(seq, core, remat, args.iters, args.tokens)
+                except Exception as e:  # OOM at long dense lengths is data
+                    row = {"seq": seq, "core": core, "remat": remat,
+                           "micro_batch": max(1, args.tokens // seq),
+                           "ms_per_step": None, "tokens_per_sec": None,
+                           "error": type(e).__name__}
+                    print(f"[longctx] {label}: {type(e).__name__}: "
+                          f"{str(e)[:200]}", file=sys.stderr)
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    fields = ["seq", "core", "remat", "micro_batch", "ms_per_step",
+              "tokens_per_sec", "error"]
+    with open(out, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=fields)
+        w.writeheader()
+        for r in rows:
+            w.writerow({k: r.get(k) for k in fields})
+    print(f"[longctx] wrote {out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

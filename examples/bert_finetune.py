@@ -93,6 +93,17 @@ def main(argv=None):
     )
     parser.add_argument("--bf16", action="store_true", help="bfloat16 MXU compute")
     parser.add_argument(
+        "--flash", action="store_true",
+        help="Pallas flash-attention core (ops/flash_attention.py); forces "
+             "attention_dropout=0 — the kernel never materializes the "
+             "[S,S] probabilities, which is the point at long --seq-len",
+    )
+    parser.add_argument(
+        "--remat", action="store_true",
+        help="jax.checkpoint each encoder layer (recompute activations in "
+             "backward — trades FLOPs for HBM at long sequence lengths)",
+    )
+    parser.add_argument(
         "--num-experts", type=int, default=0,
         help="replace each FFN with a top-1-routed MoE expert bank "
              "(expert parallelism via models/moe.py; 0 = dense)",
@@ -187,12 +198,36 @@ def main(argv=None):
             dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
             num_experts=args.num_experts,
         )
+    import dataclasses
+
+    from gradaccum_tpu.models.bert import dense_attention
+    from gradaccum_tpu.ops.flash_attention import flash_attention
+
+    overrides = {}
+    if args.remat:
+        overrides["remat"] = True
+    if args.flash:
+        overrides["attention_dropout"] = 0.0
+    if args.seq_len > cfg.max_position_embeddings:
+        if args.hf_checkpoint:
+            # warm_start bypasses init, so the checkpoint's position table
+            # keeps its row count and positions past it would silently train
+            # on the clamped last row
+            parser.error(
+                f"--seq-len {args.seq_len} exceeds the checkpoint's position "
+                f"table ({cfg.max_position_embeddings} rows); long sequences "
+                "need a model trained with a larger position embedding"
+            )
+        overrides["max_position_embeddings"] = args.seq_len
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    attention_fn = flash_attention if args.flash else dense_attention
     schedule = gt.warmup_polynomial_decay(
         args.lr, num_train_steps=max_steps,
         num_warmup_steps=int(max_steps * args.warmup_frac),
     )
     est = gt.Estimator(
-        bert_classifier_bundle(cfg, num_classes=2),
+        bert_classifier_bundle(cfg, num_classes=2, attention_fn=attention_fn),
         gt.ops.adamw(schedule, weight_decay_rate=0.01),  # optimization.py:59-65
         gt.GradAccumConfig(num_micro_batches=k, clip_norm=1.0,
                            first_step_quirk=True),  # optimization.py:76-94

@@ -164,13 +164,18 @@ def main(argv=None):
             curves[name] = (steps, losses)
         if reloaded and name in summary["runs"]:
             return  # keep the previously measured entry verbatim
-        summary["runs"][name] = {
+        entry = {
             metric_key: acc,
             "steps": steps[-1],
             "tail_loss_mean": round(tail_mean(losses), 4),
             "tail_loss_std": round(
                 float(np.std(losses[-max(1, len(losses) // 10):])), 4),
         }
+        if args.quick:
+            # keep 10x-shortened smoke entries distinguishable from full-run
+            # evidence when merged into an existing summary
+            entry["quick"] = True
+        summary["runs"][name] = entry
 
     for name, extra in MNIST_RUNS:
         if args.only not in ("all", "mnist"):
@@ -202,11 +207,13 @@ def main(argv=None):
         record(name, None, *read_curve(model_dir), acc=rmse,
                metric_key="final_test_rmse")
 
+    suffix = " — QUICK SMOKE (10x fewer steps)" if args.quick else ""
     overlay(out / "mnist_matrix.png", mnist_curves,
-            "MNIST effective-batch-200 matrix (reference Loss_Step_multiWorker.png)")
+            "MNIST effective-batch-200 matrix (reference "
+            f"Loss_Step_multiWorker.png){suffix}")
     overlay(out / "bert_accumulation.png", bert_curves,
             "BERT-Small micro-batch 8: K=4 accumulation vs none "
-            "(reference Loss_Step.png)")
+            f"(reference Loss_Step.png){suffix}")
 
     with open(out / "summary.json", "w") as f:
         json.dump(summary, f, indent=2)

@@ -1,0 +1,63 @@
+"""Device-step timing that survives a tunneled TPU backend.
+
+``jax.block_until_ready`` has been observed returning before the dispatched
+chain finishes on tunneled backends (it cost round 1 its perf artifact:
+timing with it measured Python dispatch, ~13x too fast). The reliable
+recipe, shared by ``bench.py`` and ``examples/bench_longcontext.py``:
+
+1. force completion with HOST READBACKS — the loss scalar plus the smallest
+   parameter leaf (covers the full fwd+bwd+optimizer chain of the last step);
+2. two-point timing — measure N and N/5 iterations and divide the
+   difference, cancelling the constant per-measurement overhead (the
+   tunnel's readback round-trip is ~90 ms, comparable to small-N compute).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def configure_fast_prng() -> None:
+    """XLA's hardware RNG for dropout masks (TPU-first: threefry costs ~25%
+    of a BERT-Small step). ``GRADACCUM_PRNG=threefry2x32`` restores the JAX
+    default stream."""
+    import jax
+
+    jax.config.update(
+        "jax_default_prng_impl", os.environ.get("GRADACCUM_PRNG", "rbg")
+    )
+
+
+def time_device_steps(step, state, step_args, iters: int):
+    """Seconds per ``state, aux = step(state, *step_args)`` call.
+
+    ``aux`` must carry a scalar ``"loss"``; ``state.params`` must be a
+    pytree. The caller warms up (and drains) before calling. Returns
+    ``(seconds_per_step, state)``.
+    """
+    import jax
+    import numpy as np
+
+    leaves = jax.tree.leaves(state.params)
+    idx = min(range(len(leaves)), key=lambda i: leaves[i].size)
+
+    def run(n, state):
+        t0 = time.perf_counter()
+        aux = None
+        for _ in range(n):
+            state, aux = step(state, *step_args)
+        float(jax.device_get(aux["loss"]))
+        np.asarray(jax.device_get(jax.tree.leaves(state.params)[idx]))
+        return time.perf_counter() - t0, state
+
+    n_small = max(1, iters // 5)
+    dt_big, state = run(iters, state)
+    if iters > n_small:
+        dt_small, state = run(n_small, state)
+        per_step = (dt_big - dt_small) / (iters - n_small)
+    else:
+        per_step = dt_big / iters
+    if per_step <= 0:  # timing noise swamped the two-point difference
+        per_step = dt_big / iters
+    return per_step, state
