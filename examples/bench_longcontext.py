@@ -30,8 +30,6 @@ VOCAB = 30522
 
 
 def measure_one(seq, core, remat, iters, tokens_per_step=TOKENS_PER_STEP):
-    import dataclasses
-
     import jax
     import jax.numpy as jnp
     import numpy as np

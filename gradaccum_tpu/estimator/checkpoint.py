@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import os
 import re
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, List, Optional, Tuple
 
 import jax
@@ -26,19 +28,62 @@ from flax import serialization
 _CKPT_RE = re.compile(r"ckpt-(\d+)\.msgpack$")
 
 
-def save(directory: str, state: Any, step: int, keep: int = 5) -> str:
-    """Atomically write ``state`` at ``step``; prune to ``keep`` newest."""
-    os.makedirs(directory, exist_ok=True)
-    state = jax.device_get(state)
+def _encode_and_write(directory: str, host_state: Any, step: int, keep: int) -> str:
     path = os.path.join(directory, f"ckpt-{step}.msgpack")
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(serialization.to_bytes(state))
+        f.write(serialization.to_bytes(host_state))
     os.replace(tmp, path)
     if keep:
         for _, old in all_checkpoints(directory)[:-keep]:
             os.remove(old)
     return path
+
+
+def save(directory: str, state: Any, step: int, keep: int = 5) -> str:
+    """Atomically write ``state`` at ``step``; prune to ``keep`` newest."""
+    os.makedirs(directory, exist_ok=True)
+    return _encode_and_write(directory, jax.device_get(state), step, keep)
+
+
+class AsyncCheckpointer:
+    """Overlap msgpack encode + disk write with training (orbax-style).
+
+    ``save`` blocks only on the device→host transfer (which must see a
+    consistent state) and hands serialization + IO to a single worker
+    thread; training continues during the write. At most one save is in
+    flight — a new save waits for the previous one first, preserving the
+    checkpoint ordering and the atomic tmp+rename guarantee per file.
+    Call ``wait()`` before relying on the newest file (restore, exit).
+    """
+
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-writer"
+        )
+        self._pending: Optional[Future] = None
+        self._lock = threading.Lock()
+
+    def save(self, directory: str, state: Any, step: int, keep: int = 5) -> None:
+        os.makedirs(directory, exist_ok=True)
+        with self._lock:
+            if self._pending is not None:
+                self._pending.result()  # surface errors; keep one in flight
+            host_state = jax.device_get(state)
+            self._pending = self._pool.submit(
+                _encode_and_write, directory, host_state, step, keep
+            )
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) has landed on disk."""
+        with self._lock:
+            if self._pending is not None:
+                self._pending.result()
+                self._pending = None
+
+    def close(self) -> None:
+        self.wait()
+        self._pool.shutdown(wait=True)
 
 
 def all_checkpoints(directory: str) -> List[Tuple[int, str]]:

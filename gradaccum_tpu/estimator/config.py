@@ -19,6 +19,9 @@ class RunConfig:
     log_step_count_steps: int = 100  # steps/sec logging cadence (01:76)
     save_checkpoints_steps: Optional[int] = 1000
     keep_checkpoint_max: int = 5
+    # overlap checkpoint encode+write with training (orbax-style); train
+    # blocks only on the device->host transfer. Restores/exit sync first.
+    async_checkpoint: bool = False
     # jax.profiler trace of a train-step window (TensorBoard/Perfetto):
     profile_dir: Optional[str] = None
     profile_start_step: int = 10  # skip compile + warmup steps

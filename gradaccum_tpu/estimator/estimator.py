@@ -82,6 +82,54 @@ class Estimator:
         self._eval_step = None
         self._predict_fn = None
         self._state = None  # last trained/restored state
+        self._events = None  # lazy TensorBoard event writer (events.py)
+        self._async_ckpt = None  # lazy AsyncCheckpointer (async_checkpoint)
+
+    def _ckpt_save(self, state, step_no):
+        """Route through the async writer when configured — training only
+        blocks on device→host transfer, not msgpack encode + disk IO."""
+        cfg = self.config
+        if cfg.async_checkpoint:
+            if self._async_ckpt is None:
+                self._async_ckpt = ckpt_lib.AsyncCheckpointer()
+            self._async_ckpt.save(
+                cfg.model_dir, state, step_no, cfg.keep_checkpoint_max
+            )
+        else:
+            ckpt_lib.save(cfg.model_dir, state, step_no, cfg.keep_checkpoint_max)
+
+    def _ckpt_sync(self):
+        """Wait for any in-flight async write (call before reading the
+        newest checkpoint and before trusting durability at exit)."""
+        if self._async_ckpt is not None:
+            self._async_ckpt.wait()
+
+    def close(self):
+        """Release background resources — the event-writer thread/file and
+        the async checkpoint worker. Safe to call repeatedly; later API
+        calls recreate both lazily."""
+        if self._async_ckpt is not None:
+            self._async_ckpt.close()
+            self._async_ckpt = None
+        if self._events is not None:
+            self._events.close()
+            self._events = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter shutdown: best-effort only
+
+    @property
+    def events(self):
+        """TensorBoard writer rooted at model_dir (no-op without a backend
+        or without a model_dir) — the reference's implicit summaries."""
+        if self._events is None:
+            from gradaccum_tpu.estimator.events import EventWriter
+
+            self._events = EventWriter(self.config.model_dir)
+        return self._events
 
     # -- state ----------------------------------------------------------
 
@@ -99,6 +147,7 @@ class Estimator:
         return acc.streaming_init(params, self.optimizer)
 
     def _maybe_restore(self, template):
+        self._ckpt_sync()
         d = self.config.model_dir
         if d and ckpt_lib.latest_checkpoint(d):
             state = ckpt_lib.restore(d, jax.device_get(template))
@@ -218,6 +267,8 @@ class Estimator:
         mid-accumulation-cycle accumulator state (SURVEY.md §5).
         """
         cfg = self.config
+        if cfg.model_dir:
+            os.makedirs(cfg.model_dir, exist_ok=True)  # Estimator parity
         it = iter(input_fn() if callable(input_fn) else input_fn)
         pending = None
         if state is None:
@@ -264,7 +315,7 @@ class Estimator:
             if not cfg.model_dir:
                 return
             if save_ckpt and last_saved != step_no:
-                ckpt_lib.save(cfg.model_dir, state, step_no, cfg.keep_checkpoint_max)
+                self._ckpt_save(state, step_no)
                 last_saved = step_no
             flush_loss_rows()
 
@@ -309,6 +360,8 @@ class Estimator:
             profiler.close()
 
         flush(save_ckpt=final_save)
+        if final_save:
+            self._ckpt_sync()  # durability: the newest file is on disk
         self._state = state
         return state
 
@@ -330,7 +383,7 @@ class Estimator:
         first = next(it, None)
         if first is None:
             raise ValueError("eval input_fn yielded no batches")
-        params = self._params_for_inference(first, state, checkpoint_path)
+        params, at_step = self._params_for_inference(first, state, checkpoint_path)
         eval_step = self._build_eval_step()
 
         totals: Dict[str, Any] = {}
@@ -351,6 +404,9 @@ class Estimator:
             for key, (t, c) in totals.items()
         }
         print(f"[{name}] " + " ".join(f"{k}={v:.5f}" for k, v in results.items()))
+        if self.config.model_dir:
+            self.events.scalars(results, at_step, subdir=name)
+            self.events.flush()
         results["_num_batches"] = n_batches
         return results
 
@@ -363,7 +419,7 @@ class Estimator:
         first = next(it, None)
         if first is None:
             return
-        params = self._params_for_inference(first, state, checkpoint_path)
+        params, _ = self._params_for_inference(first, state, checkpoint_path)
         if self._predict_fn is None:
             self._predict_fn = self._mesh_dispatch(self.model.predict)
         predict = self._predict_fn
@@ -407,10 +463,8 @@ class Estimator:
                 reachable_max is not None and done_steps >= reachable_max
             ) or peeked is None:
                 if self.config.model_dir:
-                    ckpt_lib.save(
-                        self.config.model_dir, state, done_steps,
-                        self.config.keep_checkpoint_max,
-                    )
+                    self._ckpt_save(state, done_steps)
+                    self._ckpt_sync()
                 results = self.evaluate(
                     eval_spec.input_fn, steps=eval_spec.steps, state=state,
                     name=eval_spec.name,
@@ -439,8 +493,12 @@ class Estimator:
         return n // (self.accum.num_micro_batches if self.mode == "scan" else 1)
 
     def _params_for_inference(self, sample_batch, state, checkpoint_path):
+        """(params, step) for evaluate/predict — step is the train step the
+        params correspond to (0 only for a genuinely fresh model), so eval
+        events land at the right x-coordinate in TensorBoard."""
+        self._ckpt_sync()
         if state is not None:
-            return state.params
+            return state.params, int(jax.device_get(state.step))
         if checkpoint_path or (
             self.config.model_dir and ckpt_lib.latest_checkpoint(self.config.model_dir)
         ):
@@ -450,13 +508,18 @@ class Estimator:
             restored = ckpt_lib.restore(
                 checkpoint_path or self.config.model_dir, template
             )
-            return jax.tree.map(jnp.asarray, restored.params)
+            return (
+                jax.tree.map(jnp.asarray, restored.params),
+                int(restored.step),
+            )
         if self._state is not None:
-            return self._state.params
-        return self._init_state(self._sample_micro(sample_batch)).params
+            return self._state.params, int(jax.device_get(self._state.step))
+        return self._init_state(self._sample_micro(sample_batch)).params, 0
 
     def _append_loss_csv(self, rows):
-        """loss-vs-step CSV — the data behind the reference's PNG curves."""
+        """loss-vs-step CSV — the data behind the reference's PNG curves —
+        plus the same scalars as TensorBoard events (the reference's implicit
+        model_dir summaries)."""
         path = os.path.join(self.config.model_dir, "loss_vs_step.csv")
         new = not os.path.exists(path)
         with open(path, "a") as f:
@@ -464,3 +527,6 @@ class Estimator:
                 f.write("step,loss\n")
             for step, loss in rows:
                 f.write(f"{step},{loss}\n")
+        for step, loss in rows:
+            self.events.scalar("loss", loss, step)
+        self.events.flush()

@@ -94,6 +94,44 @@ def data_parallel_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
     return make_mesh([(DATA_AXIS, len(devices))], devices=devices)
 
 
+def make_hybrid_mesh(
+    ici_axes: Sequence[Tuple[str, int]],
+    dcn_axes: Sequence[Tuple[str, int]],
+    devices=None,
+) -> Mesh:
+    """Mesh whose ``dcn_axes`` span slices (data-center network) and whose
+    ``ici_axes`` stay inside a slice (chip interconnect).
+
+    This is the axis-layout rule from the scaling playbook: put
+    bandwidth-hungry collectives (tensor/sequence/expert sharding, in-slice
+    data parallelism) on ICI axes and only slice-level data parallelism /
+    pipeline stages on DCN. ``jax.experimental.mesh_utils`` orders devices so
+    each ICI block is one slice; axis names follow ``dcn_axes + ici_axes``.
+
+    With a single slice (or CPU test devices, which carry no slice
+    topology), every DCN axis must have size 1 and the result degenerates to
+    :func:`make_mesh` over the ICI axes — so code written against the hybrid
+    layout runs unchanged on one slice.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    dcn_sizes = [s for _, s in dcn_axes]
+    names = tuple(n for n, _ in dcn_axes) + tuple(n for n, _ in ici_axes)
+    if int(np.prod(dcn_sizes)) == 1:
+        flat = make_mesh(list(ici_axes), devices=devices)
+        return Mesh(
+            flat.devices.reshape((1,) * len(dcn_axes) + flat.devices.shape),
+            names,
+        )
+    from jax.experimental import mesh_utils
+
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=[1] * len(dcn_axes) + [s for _, s in ici_axes],
+        dcn_mesh_shape=dcn_sizes + [1] * len(ici_axes),
+        devices=devices,
+    )
+    return Mesh(dev_array, names)
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

@@ -294,3 +294,42 @@ def test_mesh_construction():
         make_mesh(data=-1, model=-1)
     with pytest.raises(ValueError):
         make_mesh(data=16)
+
+
+def test_hybrid_mesh_single_slice_degenerates(rng):
+    """make_hybrid_mesh with size-1 DCN axes must equal a plain ICI mesh
+    with a leading singleton — and train identically on it."""
+    import jax
+
+    from gradaccum_tpu.parallel.mesh import make_hybrid_mesh, make_mesh
+
+    mesh = make_hybrid_mesh(
+        ici_axes=[("data", 4), ("model", 2)], dcn_axes=[("replica", 1)]
+    )
+    assert mesh.axis_names == ("replica", "data", "model")
+    assert dict(mesh.shape) == {"replica": 1, "data": 4, "model": 2}
+    flat = make_mesh([("data", 4), ("model", 2)])
+    assert mesh.devices.reshape(4, 2).tolist() == flat.devices.tolist()
+
+    # a psum over the hybrid mesh's ICI axes behaves like the flat mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = np.arange(4.0, dtype=np.float32)
+    y = jax.jit(
+        lambda v: v.sum(),
+        in_shardings=NamedSharding(mesh, P(("data",))),
+    )(x)
+    assert float(y) == 6.0
+
+
+def test_hybrid_mesh_multi_slice_requires_topology():
+    """Asking for >1 DCN slices on devices with no slice topology is a
+    loud error, not a silent wrong layout."""
+    import pytest as _pytest
+
+    from gradaccum_tpu.parallel.mesh import make_hybrid_mesh
+
+    with _pytest.raises(Exception):
+        make_hybrid_mesh(
+            ici_axes=[("data", 4)], dcn_axes=[("replica", 2)]
+        )
