@@ -53,3 +53,33 @@ def test_synthetic_text_task_label_correlated():
     assert len(texts) == 64 and labels.shape == (64,)
     t2, l2 = synthetic_text_task(64, seed=3)
     assert texts == t2 and (labels == l2).all()  # deterministic
+
+
+def _run_example(module_name, argv):
+    import importlib
+
+    mod = importlib.import_module(f"examples.{module_name}")
+    return mod.main(argv)
+
+
+def test_mnist_entrypoint_smoke(tmp_path):
+    res = _run_example("mnist", [
+        "--variant", "02", "--max-steps", "8",
+        "--model-dir", str(tmp_path / "m"),
+    ])
+    assert 0.0 <= res["accuracy"] <= 1.0
+
+
+def test_housing_entrypoint_smoke(tmp_path):
+    res = _run_example("housing", [
+        "--max-steps", "9", "--model-dir", str(tmp_path / "h"),
+    ])
+    assert "rmse" in res
+
+
+def test_bert_entrypoint_smoke(tmp_path):
+    res = _run_example("bert_finetune", [
+        "--task", "cola", "--accum-k", "2", "--max-steps", "4",
+        "--seq-len", "32", "--model-dir", str(tmp_path / "b"),
+    ])
+    assert 0.0 <= res["accuracy"] <= 1.0
