@@ -97,11 +97,19 @@ def measure(iters, warmup):
                                           num_warmup_steps=1000)
     opt = gt.ops.adamw(schedule, weight_decay_rate=0.01)
     state = scan_init(params, opt)
+    raw_unroll = os.environ.get("GRADACCUM_UNROLL", "1")
+    try:
+        unroll = max(1, int(raw_unroll))
+    except ValueError:
+        print(f"[bench] ignoring non-integer GRADACCUM_UNROLL={raw_unroll!r}",
+              file=sys.stderr)
+        unroll = 1
     step = jax.jit(
         gt.accumulate_scan(
             bundle.loss,
             opt,
-            gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0),
+            gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0,
+                               unroll=unroll),
             needs_rng=True,
         ),
         donate_argnums=0,
@@ -131,6 +139,7 @@ def measure(iters, warmup):
         "mfu": round(mfu, 4) if mfu is not None else None,
         "flops_per_seq": flops_per_seq,
         "device": f"{dev.device_kind} ({dev.platform}) x{jax.device_count()}",
+        "unroll": unroll,
     }
 
 

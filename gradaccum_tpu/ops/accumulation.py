@@ -69,6 +69,12 @@ class GradAccumConfig(NamedTuple):
     clip_norm: Optional[float] = None  # BERT flavor: 1.0; MNIST/housing: None
     axis_name: Optional[str] = None  # data-parallel mesh axis, if any
     first_step_quirk: bool = True  # streaming mode only
+    # lax.scan unroll factor for scan mode (1 = rolled). Unrolling lets XLA
+    # fuse the K micro-steps' gradient adds instead of round-tripping the
+    # f32 accumulator carry through HBM every iteration. Same accumulation
+    # order; fusion can still change f32 rounding at the ULP level. K x the
+    # step's code size. True unrolls fully.
+    unroll: Any = 1
 
 
 # loss_fn(params, micro_batch) -> scalar loss (mean over the micro batch).
@@ -175,7 +181,8 @@ def accumulate_scan(
             return accum, loss
 
         accum0 = tree_zeros_like(diff_params)
-        accum, losses = lax.scan(body, accum0, xs, length=k)
+        accum, losses = lax.scan(body, accum0, xs, length=k,
+                                 unroll=config.unroll)
         if axis is not None:
             accum = lax.psum(accum, axis)  # the one collective per update
             denom = k * lax.axis_size(axis)

@@ -241,3 +241,46 @@ def test_needs_rng_missing_key_raises(rng):
 
     with pytest.raises(ValueError, match="needs_rng"):
         step(scan_init(params, opt), stack_micro_batches(big, K))
+
+
+def test_scan_unroll_allclose(rng):
+    """unroll is a scheduling knob: fully-unrolled and rolled scans keep the
+    same accumulation order, differing only in XLA-fusion rounding (f32 ULP
+    level), so states must agree to tight tolerance."""
+    import jax
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.ops.accumulation import scan_init, stack_micro_batches
+
+    k = 4
+    x = rng.normal(size=(k * 8, 5)).astype(np.float32)
+    y = rng.normal(size=(k * 8, 1)).astype(np.float32)
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    opt = gt.ops.adamw(1e-2, weight_decay_rate=0.01)
+    params = {"w": jnp.zeros((5, 1))}
+    batch = stack_micro_batches({"x": x, "y": y}, k)
+
+    def run(unroll):
+        step = jax.jit(gt.accumulate_scan(
+            loss_fn, opt,
+            gt.GradAccumConfig(num_micro_batches=k, clip_norm=1.0,
+                               unroll=unroll),
+        ))
+        state = scan_init(params, opt)
+        for _ in range(3):
+            state, aux = step(state, batch)
+        return jax.device_get(state), float(aux["loss"])
+
+    s1, l1 = run(1)
+    s2, l2 = run(True)
+    np.testing.assert_allclose(l1, l2, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        ),
+        s1, s2,
+    )
