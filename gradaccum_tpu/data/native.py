@@ -66,6 +66,24 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64,
     ]
     lib.ga_csv_read.restype = ctypes.c_int
+    lib.ga_wp_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.ga_wp_create.restype = ctypes.c_void_p
+    lib.ga_wp_destroy.argtypes = [ctypes.c_void_p]
+    lib.ga_wp_destroy.restype = None
+    lib.ga_wp_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32,
+        i32p, i32p, i32p,
+    ]
+    lib.ga_wp_encode.restype = ctypes.c_int
+    lib.ga_wp_encode_batch.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32, ctypes.c_int32,
+        i32p, i32p, i32p, i32p,
+    ]
+    lib.ga_wp_encode_batch.restype = ctypes.c_int
     return lib
 
 
@@ -158,3 +176,116 @@ def read_csv_numeric(path: str, skip_header: bool = True) -> Optional[Tuple[np.n
         "csv_read", path,
     )
     return out.reshape(n_rows.value, n_cols.value), n_cols.value
+
+
+NONASCII = -6
+
+
+def _native_safe(text: Optional[str]) -> bool:
+    """Can the C string interface see this text faithfully? Interior NULs
+    truncate at the C boundary with no error, so they must take the Python
+    path (as must non-ASCII; control bytes are rejected by the C side)."""
+    return text is None or (text.isascii() and "\x00" not in text)
+
+
+class NativeWordPiece:
+    """Handle to the C++ WordPiece encoder (ASCII fast path).
+
+    ``encode`` returns (ids, mask, segments) int32 arrays, or None when the
+    text needs the full-Unicode Python path (non-ASCII bytes) — the caller
+    falls back transparently. Thread-compat: encode is reentrant (the
+    handle's vocab is read-only after construction).
+    """
+
+    def __init__(self, vocab_tokens, pad_id, unk_id, cls_id, sep_id,
+                 lower=True):
+        self._lib = get_lib()
+        self._handle = None
+        if self._lib is None:
+            return
+        if any(not _native_safe(t) for t in vocab_tokens):
+            # non-ASCII (or NUL-bearing) vocab entries could only match text
+            # the native path rejects anyway. Replace them with " ": basic
+            # tokenization splits on whitespace, so no produced token can
+            # ever equal a lone space — the placeholder is unmatchable.
+            vocab_tokens = [t if _native_safe(t) else " " for t in vocab_tokens]
+        arr = (ctypes.c_char_p * len(vocab_tokens))(
+            *[t.encode() for t in vocab_tokens]
+        )
+        self._handle = self._lib.ga_wp_create(
+            arr, len(vocab_tokens), pad_id, unk_id, cls_id, sep_id, int(lower)
+        )
+
+    @property
+    def available(self) -> bool:
+        return self._handle is not None
+
+    def encode(self, text_a: str, text_b: Optional[str], max_seq_length: int):
+        if self._handle is None:
+            return None
+        if not _native_safe(text_a) or not _native_safe(text_b):
+            return None
+        ids = np.empty(max_seq_length, np.int32)
+        mask = np.empty(max_seq_length, np.int32)
+        seg = np.empty(max_seq_length, np.int32)
+        rc = self._lib.ga_wp_encode(
+            self._handle, text_a.encode(),
+            text_b.encode() if text_b else None, max_seq_length,
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            mask.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            seg.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        if rc == NONASCII:
+            return None
+        if rc != 0:
+            raise ValueError(f"native wordpiece encode failed with code {rc}")
+        return ids, mask, seg
+
+    def encode_batch(self, texts, text_pairs, max_seq_length: int):
+        """One C call for the whole batch. Returns (ids, mask, seg) arrays
+        of shape [n, max_seq] plus a bool array of rows that need the
+        Python path (non-ASCII), or None when native is unavailable."""
+        if self._handle is None:
+            return None
+        n = len(texts)
+        ascii_a = [_native_safe(t) for t in texts]
+        pairs = text_pairs if text_pairs is not None else [None] * n
+        # non-ASCII rows get "" placeholders: encoded (cheaply) but replaced
+        arr_a = (ctypes.c_char_p * n)(
+            *[t.encode() if ok else b"" for t, ok in zip(texts, ascii_a)]
+        )
+        has_pairs = any(p for p in pairs)
+        arr_b = None
+        ascii_b = [_native_safe(p) for p in pairs]
+        if has_pairs:
+            arr_b = (ctypes.c_char_p * n)(
+                *[p.encode() if (p and ok) else None
+                  for p, ok in zip(pairs, ascii_b)]
+            )
+        ids = np.empty((n, max_seq_length), np.int32)
+        mask = np.empty((n, max_seq_length), np.int32)
+        seg = np.empty((n, max_seq_length), np.int32)
+        status = np.empty(n, np.int32)
+        p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        rc = self._lib.ga_wp_encode_batch(
+            self._handle, arr_a, arr_b, n, max_seq_length,
+            p(ids), p(mask), p(seg), p(status),
+        )
+        if rc != 0:
+            raise ValueError(f"native wordpiece batch failed with code {rc}")
+        needs_python = np.zeros(n, bool)
+        for i in range(n):
+            if not ascii_a[i] or not ascii_b[i] or status[i] == NONASCII:
+                needs_python[i] = True
+            elif status[i] != 0:
+                raise ValueError(
+                    f"native wordpiece encode failed with code {int(status[i])}"
+                )
+        return ids, mask, seg, needs_python
+
+    def __del__(self):
+        try:
+            if self._handle is not None and self._lib is not None:
+                self._lib.ga_wp_destroy(self._handle)
+        except Exception:
+            pass  # interpreter shutdown

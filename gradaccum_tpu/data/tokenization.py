@@ -91,6 +91,26 @@ class Tokenizer:
         for tok in (PAD, UNK, CLS, SEP):
             if tok not in vocab:
                 raise ValueError(f"vocab is missing special token {tok}")
+        self._native = None  # lazy C++ encoder (ASCII fast path)
+        self._native_tried = False
+
+    def _native_encoder(self):
+        if not self._native_tried:
+            self._native_tried = True
+            from gradaccum_tpu.data.native import NativeWordPiece
+
+            # vocab ids are positions: build the position->token list
+            tokens = [self.inv_vocab[i] for i in range(len(self.vocab))] if (
+                sorted(self.vocab.values()) == list(range(len(self.vocab)))
+            ) else None
+            if tokens is not None:
+                enc = NativeWordPiece(
+                    tokens, self.vocab[PAD], self.vocab[UNK],
+                    self.vocab[CLS], self.vocab[SEP], lower=self.lower,
+                )
+                if enc.available:
+                    self._native = enc
+        return self._native
 
     def tokenize(self, text: str) -> List[str]:
         out: List[str] = []
@@ -110,7 +130,24 @@ class Tokenizer:
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """run_classifier.py feature conversion: ``[CLS] a [SEP] b? [SEP]``,
         truncated then zero-padded; returns (input_ids, input_mask,
-        segment_ids) int32 arrays of length max_seq_length."""
+        segment_ids) int32 arrays of length max_seq_length.
+
+        ASCII inputs encode through the native C++ path when the library is
+        built (byte-identical output, parity-tested); non-ASCII inputs take
+        the full-Unicode Python path."""
+        native = self._native_encoder()
+        if native is not None:
+            out = native.encode(text_a, text_b, max_seq_length)
+            if out is not None:
+                return out
+        return self._encode_python(text_a, text_b, max_seq_length)
+
+    def _encode_python(
+        self,
+        text_a: str,
+        text_b: Optional[str] = None,
+        max_seq_length: int = 128,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         tokens_a = self.tokenize(text_a)
         tokens_b = self.tokenize(text_b) if text_b else None
         if tokens_b:
@@ -141,6 +178,22 @@ class Tokenizer:
 
     def encode_batch(self, texts, text_pairs=None, max_seq_length: int = 128):
         pairs = text_pairs if text_pairs is not None else [None] * len(texts)
+        native = self._native_encoder()
+        if native is not None and texts:
+            # one C call for the whole batch; only non-ASCII rows re-encode
+            # through the Python path below
+            out = native.encode_batch(texts, text_pairs, max_seq_length)
+            if out is not None:
+                ids, mask, seg, needs_python = out
+                for i in np.flatnonzero(needs_python):
+                    ids[i], mask[i], seg[i] = self._encode_python(
+                        texts[i], pairs[i], max_seq_length
+                    )
+                return {
+                    "input_ids": ids,
+                    "input_mask": mask,
+                    "segment_ids": seg,
+                }
         trip = [self.encode(a, b, max_seq_length) for a, b in zip(texts, pairs)]
         ids, mask, seg = zip(*trip)
         return {

@@ -15,10 +15,12 @@
 
 #include <zlib.h>
 
+#include <cctype>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -218,6 +220,203 @@ int ga_csv_read(const char* path, int skip_header, float* out, int64_t len) {
     p = nl + 1;
   }
   return written == len ? 0 : kErrSize;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// WordPiece encoder — ASCII fast path.
+//
+// The Python tokenizer (gradaccum_tpu/data/tokenization.py) implements the
+// full run_classifier.py contract including Unicode NFD accent stripping;
+// this native encoder handles the hot ASCII case (the entirety of typical
+// English corpora) with byte-identical output: lowercase, whitespace +
+// ASCII-punctuation split, greedy longest-match WordPiece with "##"
+// continuations, [CLS] a [SEP] b? [SEP] packing with pair truncation and
+// zero padding. Any non-ASCII byte returns kErrNonAscii and the Python
+// side falls back to its own implementation, so Unicode correctness is
+// never compromised for speed.
+
+namespace {
+
+constexpr int kErrNonAscii = -6;
+constexpr int kErrVocab = -7;
+constexpr int kMaxWordChars = 100;  // tokenization.py wordpiece max_chars
+
+struct WordPieceEncoder {
+  std::unordered_map<std::string, int> vocab;
+  int pad_id, unk_id, cls_id, sep_id;
+  bool lower;
+};
+
+bool AsciiPunct(unsigned char c) {
+  return (c >= 33 && c <= 47) || (c >= 58 && c <= 64) || (c >= 91 && c <= 96) ||
+         (c >= 123 && c <= 126);
+}
+
+// basic_tokenize for ASCII: lowercase, split whitespace, punctuation is its
+// own token. Returns false on any non-ASCII byte.
+bool BasicTokenize(const WordPieceEncoder& enc, const char* text,
+                   std::vector<std::string>* out) {
+  std::string current;
+  for (const char* p = text; *p; ++p) {
+    unsigned char c = static_cast<unsigned char>(*p);
+    // reject non-ASCII and control bytes outside C whitespace: Python's
+    // str.isspace() counts 0x1C-0x1F as whitespace where std::isspace does
+    // not, so those inputs must take the Python path to keep parity
+    if (c >= 128 || (c < 32 && !std::isspace(c))) return false;
+    if (enc.lower) c = static_cast<unsigned char>(std::tolower(c));
+    if (std::isspace(c)) {
+      if (!current.empty()) {
+        out->push_back(current);
+        current.clear();
+      }
+    } else if (AsciiPunct(c)) {
+      if (!current.empty()) {
+        out->push_back(current);
+        current.clear();
+      }
+      out->push_back(std::string(1, static_cast<char>(c)));
+    } else {
+      current.push_back(static_cast<char>(c));
+    }
+  }
+  if (!current.empty()) out->push_back(current);
+  return true;
+}
+
+// Greedy longest-match-first WordPiece (tokenization.py wordpiece_tokenize).
+void WordPiece(const WordPieceEncoder& enc, const std::string& token,
+               std::vector<int>* ids) {
+  if (token.size() > kMaxWordChars) {
+    ids->push_back(enc.unk_id);
+    return;
+  }
+  std::vector<int> pieces;
+  size_t start = 0;
+  while (start < token.size()) {
+    size_t end = token.size();
+    int piece = -1;
+    while (start < end) {
+      std::string sub = token.substr(start, end - start);
+      if (start > 0) sub = "##" + sub;
+      auto it = enc.vocab.find(sub);
+      if (it != enc.vocab.end()) {
+        piece = it->second;
+        break;
+      }
+      --end;
+    }
+    if (piece < 0) {
+      ids->push_back(enc.unk_id);
+      return;
+    }
+    pieces.push_back(piece);
+    start = end;
+  }
+  ids->insert(ids->end(), pieces.begin(), pieces.end());
+}
+
+bool TokenizeToIds(const WordPieceEncoder& enc, const char* text,
+                   std::vector<int>* ids) {
+  std::vector<std::string> words;
+  if (!BasicTokenize(enc, text, &words)) return false;
+  for (const auto& w : words) WordPiece(enc, w, ids);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// vocab: n NUL-terminated token strings, id = position. The four special
+// ids are passed explicitly so the C++ side never guesses token spellings.
+void* ga_wp_create(const char** vocab, int32_t n, int32_t pad_id,
+                   int32_t unk_id, int32_t cls_id, int32_t sep_id,
+                   int32_t lower) {
+  if (n <= 0 || pad_id >= n || unk_id >= n || cls_id >= n || sep_id >= n ||
+      pad_id < 0 || unk_id < 0 || cls_id < 0 || sep_id < 0) {
+    return nullptr;
+  }
+  auto* enc = new WordPieceEncoder();
+  enc->vocab.reserve(n);
+  for (int32_t i = 0; i < n; ++i) enc->vocab.emplace(vocab[i], i);
+  enc->pad_id = pad_id;
+  enc->unk_id = unk_id;
+  enc->cls_id = cls_id;
+  enc->sep_id = sep_id;
+  enc->lower = lower != 0;
+  return enc;
+}
+
+void ga_wp_destroy(void* handle) {
+  delete static_cast<WordPieceEncoder*>(handle);
+}
+
+// Encode one example into ids/mask/seg (each max_seq int32). text_b may be
+// NULL. Returns 0, kErrNonAscii (caller falls back to Python), or kErrVocab.
+int ga_wp_encode(void* handle, const char* text_a, const char* text_b,
+                 int32_t max_seq, int32_t* ids, int32_t* mask, int32_t* seg) {
+  if (handle == nullptr) return kErrVocab;
+  const auto& enc = *static_cast<WordPieceEncoder*>(handle);
+  std::vector<int> a, b;
+  if (!TokenizeToIds(enc, text_a, &a)) return kErrNonAscii;
+  bool pair = text_b != nullptr && text_b[0] != '\0';
+  if (pair && !TokenizeToIds(enc, text_b, &b)) return kErrNonAscii;
+  if (max_seq < (pair ? 3 : 2)) return kErrVocab;  // room for specials
+
+  if (pair) {
+    // truncate the longer of the pair until it fits (BERT convention)
+    while (a.size() + b.size() > size_t(max_seq) - 3) {
+      if (a.size() >= b.size()) {
+        a.pop_back();
+      } else {
+        b.pop_back();
+      }
+    }
+  } else if (a.size() > size_t(max_seq) - 2) {
+    a.resize(max_seq - 2);
+  }
+
+  int32_t pos = 0;
+  auto put = [&](int id, int s) {
+    ids[pos] = id;
+    mask[pos] = 1;
+    seg[pos] = s;
+    ++pos;
+  };
+  put(enc.cls_id, 0);
+  for (int id : a) put(id, 0);
+  put(enc.sep_id, 0);
+  if (pair) {
+    for (int id : b) put(id, 1);
+    put(enc.sep_id, 1);
+  }
+  for (; pos < max_seq;) {
+    ids[pos] = enc.pad_id;
+    mask[pos] = 0;
+    seg[pos] = 0;
+    ++pos;
+  }
+  return 0;
+}
+
+// Batch encode: n examples into row-major [n, max_seq] outputs, one ctypes
+// round-trip for the whole batch. texts_b may be NULL (no pairs) or hold
+// NULL entries. status[i] gets the per-example ga_wp_encode code so the
+// Python side can re-encode only the non-ASCII rows through its own path.
+int ga_wp_encode_batch(void* handle, const char** texts_a,
+                       const char** texts_b, int32_t n, int32_t max_seq,
+                       int32_t* ids, int32_t* mask, int32_t* seg,
+                       int32_t* status) {
+  if (handle == nullptr) return kErrVocab;
+  for (int32_t i = 0; i < n; ++i) {
+    const char* b = texts_b ? texts_b[i] : nullptr;
+    int64_t off = int64_t(i) * max_seq;
+    status[i] = ga_wp_encode(handle, texts_a[i], b, max_seq, ids + off,
+                             mask + off, seg + off);
+  }
+  return 0;
 }
 
 }  // extern "C"

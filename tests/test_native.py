@@ -194,3 +194,90 @@ def test_csv_crlf_and_no_trailing_newline(tmp_path):
 def test_native_disabled_by_env(tmp_path, monkeypatch):
     monkeypatch.setenv("GRADACCUM_NATIVE", "0")
     assert native.read_idx_images(str(tmp_path / "whatever")) is None
+
+
+def test_wordpiece_native_matches_python(tmp_path):
+    """ASCII inputs must encode byte-identically through the C++ and Python
+    WordPiece paths — ids, mask, and segments — including pairs, truncation,
+    punctuation splits, unknown words, and ##continuations."""
+    from gradaccum_tpu.data.tokenization import build_vocab
+
+    corpus = ["the cat sat on the mat", "a dog runs fast!", "unbelievable",
+              "it's a fine day, isn't it?", "running runner ran"]
+    tok = build_vocab(corpus, size=64)
+    assert tok._native_encoder() is not None, "native wordpiece not built"
+
+    cases = [
+        ("the cat sat", None),
+        ("a dog runs fast!", None),
+        ("unbelievable running", "the mat."),
+        ("THE CAT", None),                       # lowercase path
+        ("totally-unseen zqxj", None),           # UNK + punctuation split
+        ("word " * 200, "pad " * 150),           # pair truncation loop
+        ("", None),                              # empty text
+    ]
+    for text_a, text_b in cases:
+        got = tok._native_encoder().encode(text_a, text_b, 32)
+        assert got is not None, (text_a, text_b)
+        # force the Python path for the reference output
+        tok2 = build_vocab(corpus, size=64)
+        tok2._native_tried = True  # skip native: pure-Python reference
+        want = tok2.encode(text_a, text_b, max_seq_length=32)
+        for g, w, name in zip(got, want, ["ids", "mask", "segments"]):
+            np.testing.assert_array_equal(
+                g, w, err_msg=f"{name} differ for {(text_a[:20], text_b)}"
+            )
+
+
+def test_wordpiece_native_rejects_non_ascii(tmp_path):
+    from gradaccum_tpu.data.tokenization import build_vocab
+
+    tok = build_vocab(["plain ascii corpus"], size=64)
+    enc = tok._native_encoder()
+    assert enc is not None
+    assert enc.encode("café au lait", None, 16) is None  # Python handles it
+    ids, mask, seg = tok.encode("café au lait", max_seq_length=16)
+    assert mask.sum() > 0  # full pipeline still works via fallback
+
+
+def test_wordpiece_native_batch_parity_mixed_unicode():
+    """encode_batch routes ASCII rows through one native C call and
+    non-ASCII rows through Python — output must equal the all-Python path
+    row for row, including pair batches."""
+    from gradaccum_tpu.data.tokenization import build_vocab
+
+    corpus = ["plain ascii text", "with punctuation, too!", "more words here"]
+    tok = build_vocab(corpus, size=128)
+    assert tok._native_encoder() is not None
+    tok_py = build_vocab(corpus, size=128)
+    tok_py._native_tried = True  # pure-Python reference
+
+    texts = ["plain text", "café au lait", "naïve approach!", "ascii again", ""]
+    pairs = [None, "more words", "plain", None, "touché"]
+    for text_pairs in (None, pairs):
+        got = tok.encode_batch(texts, text_pairs, max_seq_length=16)
+        want = tok_py.encode_batch(texts, text_pairs, max_seq_length=16)
+        for key in want:
+            np.testing.assert_array_equal(got[key], want[key], err_msg=key)
+
+
+def test_wordpiece_native_control_bytes_fall_back():
+    """Interior NULs truncate at the C boundary and 0x1C-0x1F are whitespace
+    to Python but not to std::isspace — both must take the Python path and
+    match the all-Python output exactly."""
+    from gradaccum_tpu.data.tokenization import build_vocab
+
+    corpus = ["cat dog fish", "short rest of sentence"]
+    tok = build_vocab(corpus, size=128)
+    enc = tok._native_encoder()
+    assert enc is not None
+    tok_py = build_vocab(corpus, size=128)
+    tok_py._native_tried = True
+
+    tricky = ["cat\x1cdog", "short\x00 rest", "cat\x1ddog fish", "plain cat"]
+    assert enc.encode(tricky[0], None, 16) is None
+    assert enc.encode(tricky[1], None, 16) is None
+    got = tok.encode_batch(tricky, max_seq_length=16)
+    want = tok_py.encode_batch(tricky, max_seq_length=16)
+    for key in want:
+        np.testing.assert_array_equal(got[key], want[key], err_msg=key)
