@@ -1,4 +1,13 @@
-from gradaccum_tpu.parallel import dp, mesh, pp, ring_attention, sharding, sp, tp
+from gradaccum_tpu.parallel import (
+    dp,
+    mesh,
+    pp,
+    ring_attention,
+    sharding,
+    sp,
+    tp,
+    ulysses,
+)
 from gradaccum_tpu.parallel.cross_shard import cross_shard_optimizer
 from gradaccum_tpu.parallel.dp import make_dp_train_step, make_pjit_dp_train_step
 from gradaccum_tpu.parallel.pp import make_pp_train_step, pp_init, stack_stage_params
@@ -27,3 +36,4 @@ from gradaccum_tpu.parallel.sharding import (
 )
 from gradaccum_tpu.parallel.sp import make_dp_sp_train_step
 from gradaccum_tpu.parallel.tp import bert_tp_rules
+from gradaccum_tpu.parallel.ulysses import make_ulysses_attention_fn, ulysses_attention

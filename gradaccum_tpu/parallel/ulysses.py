@@ -1,0 +1,82 @@
+"""Sequence parallelism via all-to-all (DeepSpeed-Ulysses style).
+
+The second of the two standard sequence-parallel attention layouts (the
+first, ring attention, is :mod:`.ring_attention` — the reference itself has
+no long-context support at all, SURVEY.md §5):
+
+- **ring**: every rank keeps its query block, K/V blocks rotate around the
+  ring (n-1 ``ppermute`` hops overlapped with block matmuls). Communication
+  scales with n hops; attention math is the online-softmax blockwise form.
+- **ulysses** (this module): one ``all_to_all`` (q, k, v stacked into a
+  single collective) re-partitions activations from sequence-sharded
+  ``[B, h, S/n, D]`` to *head*-sharded ``[B, h/n, S, D]``, each rank runs
+  ordinary dense attention for its head subset over the FULL sequence, and
+  a second ``all_to_all`` restores sequence sharding — two activation
+  all_to_alls per call (plus a small key-mask ``all_gather``), typically
+  cheaper than the ring's n-1 hops on all-to-all-friendly fabrics (TPU ICI
+  torus included) when ``heads % n == 0``.
+
+Signature-compatible with ``models.bert.dense_attention`` and
+:func:`..ring_attention.make_ring_attention_fn`: must run inside
+``shard_map`` with the sequence dim sharded over ``axis``; drops into
+``bert_classifier_bundle(..., seq_axis=..., attention_fn=...)`` and
+``sp.make_dp_sp_train_step`` unchanged — the train step never inspects
+which core the model uses.
+
+Attention dropout is rejected like the other distributed cores: a
+replicated rng would draw identical masks for different head subsets, and
+per-rank keys would break seq-invariance of the head gradients.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+from jax import lax
+
+from gradaccum_tpu.parallel.mesh import SEQ_AXIS
+
+
+def ulysses_attention(q, k, v, mask=None, dropout_fn=None, *, axis: str = SEQ_AXIS):
+    """All-to-all sequence-parallel attention core.
+
+    ``q, k, v``: [B, heads, S_local, head_dim] (sequence-sharded over
+    ``axis``); ``mask``: additive key mask [B, 1, 1, S_local] or None.
+    Returns [B, heads, S_local, head_dim]. ``heads`` must be divisible by
+    the ``axis`` size.
+    """
+    if dropout_fn is not None:
+        raise NotImplementedError(
+            "ulysses_attention does not support attention dropout; "
+            "set attention_dropout=0.0"
+        )
+    # function-local import: parallel/__init__ -> ulysses -> models.bert ->
+    # estimator -> parallel.dp would otherwise re-enter the package init
+    from gradaccum_tpu.models.bert import dense_attention
+
+    n = lax.axis_size(axis)
+    heads = q.shape[1]
+    if heads % n != 0:
+        raise ValueError(
+            f"ulysses attention needs heads ({heads}) divisible by the "
+            f"'{axis}' axis size ({n}); use ring attention otherwise"
+        )
+
+    # one collective for all three operands: [3, B, h, S/n, D] -> head-shard
+    qkv = lax.all_to_all(
+        jnp.stack([q, k, v]), axis, split_axis=2, concat_axis=3, tiled=True
+    )
+    qg, kg, vg = qkv[0], qkv[1], qkv[2]
+    if mask is not None:
+        mask = lax.all_gather(mask, axis, axis=3, tiled=True)  # [B,1,1,S]
+
+    # full-sequence dense attention for this rank's head subset
+    ctx = dense_attention(qg, kg, vg, mask, dropout_fn=None)
+    # restore sequence sharding: [B, h/n, S, D] -> [B, h, S/n, D]
+    return lax.all_to_all(ctx, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def make_ulysses_attention_fn(axis: str = SEQ_AXIS):
+    """Bind the mesh axis: an ``attention_fn`` for ``BertEncoder``."""
+    return partial(ulysses_attention, axis=axis)
