@@ -53,7 +53,8 @@ BERT_RUNS = [
 HOUSING_RUN = ("housing_b59_k3", ["--max-steps", "3000"])
 
 
-def run_one(script, name, extra, run_root, quick, cpu_mesh=True):
+def run_one(script, name, extra, run_root, quick, cpu_mesh=True,
+            run_timeout=1800):
     """``cpu_mesh``: force the 8-device virtual CPU mesh (required for the
     2-worker MNIST variants). With False the run inherits the ambient
     platform — the real TPU chip when one is attached, CPU otherwise —
@@ -75,7 +76,7 @@ def run_one(script, name, extra, run_root, quick, cpu_mesh=True):
     for attempt in range(3):  # the axon TPU tunnel can hang at backend init
         try:
             proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                                  cwd=str(REPO), timeout=1800)
+                                  cwd=str(REPO), timeout=run_timeout)
             break
         except subprocess.TimeoutExpired:
             print(f"[run] {name}: attempt {attempt + 1} timed out, retrying",
@@ -138,6 +139,12 @@ def main(argv=None):
         "--only", choices=["all", "mnist", "bert", "housing"], default="all",
         help="rerun one group; other groups' curves reload from --out",
     )
+    ap.add_argument(
+        "--run-timeout", type=int, default=1800,
+        help="per-attempt subprocess timeout in seconds (raise for slow "
+             "CPU-only machines; the default assumes accelerator-speed runs "
+             "and exists to catch hung TPU-tunnel backend inits)",
+    )
     args = ap.parse_args(argv)
 
     out = Path(args.out)
@@ -182,7 +189,8 @@ def main(argv=None):
             record(name, mnist_curves, *read_curve_file(out / f"{name}.csv"),
                    reloaded=True)
             continue
-        model_dir, acc = run_one("mnist.py", name, extra, run_root, args.quick)
+        model_dir, acc = run_one("mnist.py", name, extra, run_root,
+                                 args.quick, run_timeout=args.run_timeout)
         shutil.copy(os.path.join(model_dir, "loss_vs_step.csv"),
                     out / f"{name}.csv")
         record(name, mnist_curves, *read_curve(model_dir), acc=acc)
@@ -193,7 +201,8 @@ def main(argv=None):
                    reloaded=True)
             continue
         model_dir, acc = run_one("bert_finetune.py", name, extra, run_root,
-                                 args.quick, cpu_mesh=False)
+                                 args.quick, cpu_mesh=False,
+                                 run_timeout=args.run_timeout)
         shutil.copy(os.path.join(model_dir, "loss_vs_step.csv"),
                     out / f"{name}.csv")
         record(name, bert_curves, *read_curve(model_dir), acc=acc)
@@ -201,7 +210,7 @@ def main(argv=None):
     if args.only in ("all", "housing"):
         name, extra = HOUSING_RUN
         model_dir, rmse = run_one("housing.py", name, extra, run_root,
-                                  args.quick)
+                                  args.quick, run_timeout=args.run_timeout)
         shutil.copy(os.path.join(model_dir, "loss_vs_step.csv"),
                     out / f"{name}.csv")
         record(name, None, *read_curve(model_dir), acc=rmse,
