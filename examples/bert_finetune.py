@@ -108,6 +108,20 @@ def main(argv=None):
         help="replace each FFN with a top-1-routed MoE expert bank "
              "(expert parallelism via models/moe.py; 0 = dense)",
     )
+    parser.add_argument(
+        "--dp", type=int, default=1,
+        help="data-parallel mesh width (the reference's worker count, 03:76)",
+    )
+    parser.add_argument(
+        "--tp", type=int, default=1,
+        help="tensor-parallel width: shard QKV/FFN kernels and the vocab "
+             "embedding over a 'model' axis (bert_tp_rules)",
+    )
+    parser.add_argument(
+        "--ep", type=int, default=1,
+        help="expert-parallel width: shard the MoE expert bank over an "
+             "'expert' axis (moe_ep_rules; requires --num-experts)",
+    )
     parser.add_argument("--full", action="store_true",
                         help="reference scale: 3 epochs over the corpus")
     parser.add_argument(
@@ -127,6 +141,13 @@ def main(argv=None):
     if args.hf_checkpoint and args.num_experts:
         parser.error("--num-experts cannot combine with --hf-checkpoint "
                      "(pretrained dense FFN weights have no expert bank)")
+    if min(args.dp, args.tp, args.ep) < 1:
+        parser.error("--dp/--tp/--ep must be >= 1")
+    if args.tp > 1 and args.ep > 1:
+        parser.error("--tp and --ep cannot combine (one model-sharding rule "
+                     "set at a time; both compose with --dp)")
+    if args.ep > 1 and (args.num_experts == 0 or args.num_experts % args.ep):
+        parser.error("--ep requires --num-experts divisible by it")
 
     import jax.numpy as jnp
     import numpy as np
@@ -174,7 +195,8 @@ def main(argv=None):
     k = args.accum_k if args.accum_k is not None else t["k"]
     if args.full:
         # 3 epochs in micro-batch steps (README.md:75's formula)
-        max_steps = len(train_labels) * 3 // micro
+        # each micro-step consumes micro rows per data-parallel replica
+        max_steps = len(train_labels) * 3 // (micro * args.dp)
     else:
         max_steps = args.max_steps
 
@@ -226,6 +248,32 @@ def main(argv=None):
         args.lr, num_train_steps=max_steps,
         num_warmup_steps=int(max_steps * args.warmup_frac),
     )
+    mesh, rules = None, None
+    n_mesh = args.dp * args.tp * args.ep
+    if n_mesh > 1:
+        import jax
+
+        from gradaccum_tpu.parallel.mesh import make_mesh
+
+        if n_mesh > len(jax.devices()):
+            parser.error(f"mesh needs {n_mesh} devices, have {len(jax.devices())}")
+        if args.tp > 1:
+            from gradaccum_tpu.parallel.tp import bert_tp_rules
+
+            mesh = make_mesh(data=args.dp, model=args.tp,
+                             devices=jax.devices()[:n_mesh])
+            rules = bert_tp_rules()
+        elif args.ep > 1:
+            from gradaccum_tpu.models.moe import moe_ep_rules
+
+            mesh = make_mesh(data=args.dp, expert=args.ep,
+                             devices=jax.devices()[:n_mesh])
+            rules = moe_ep_rules()
+        else:  # pure DP: the shard_map path (explicit ring collectives)
+            mesh = make_mesh(data=args.dp, devices=jax.devices()[:n_mesh])
+        print(f"[mesh] {dict(mesh.shape)}"
+              + (f" rules={'tp' if args.tp > 1 else 'ep'}" if rules else ""))
+
     est = gt.Estimator(
         bert_classifier_bundle(cfg, num_classes=2, attention_fn=attention_fn),
         gt.ops.adamw(schedule, weight_decay_rate=0.01),  # optimization.py:59-65
@@ -234,9 +282,13 @@ def main(argv=None):
         gt.RunConfig(model_dir=model_dir, log_step_count_steps=max(max_steps // 20, 1)),
         mode=args.mode,
         warm_start=pretrained,
+        mesh=mesh,
+        sharding_rules=rules,
     )
 
-    host_batch = micro * (k if args.mode == "scan" else 1)
+    # per-device micro-batch × data-parallel width (mnist 03/04 semantics:
+    # each "worker" sees its own `micro` rows) × K in scan mode
+    host_batch = micro * args.dp * (k if args.mode == "scan" else 1)
 
     def train_fn():
         return (

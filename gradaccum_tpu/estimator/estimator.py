@@ -64,13 +64,24 @@ class Estimator:
         mesh=None,
         mode: str = "streaming",
         warm_start=None,
+        sharding_rules=None,
     ):
         """``warm_start``: a params pytree used instead of ``model.init`` for
         fresh runs (tf.estimator's WarmStartSettings slot — how pretrained
         BERT weights enter the fine-tune, README.md:66-72). A newer
-        checkpoint in ``model_dir`` still wins, exactly like Estimator."""
+        checkpoint in ``model_dir`` still wins, exactly like Estimator.
+
+        ``sharding_rules``: optional regex → ``PartitionSpec`` rules (e.g.
+        ``bert_tp_rules()``, ``moe_ep_rules()``) laying the TrainState out
+        over the mesh's model/expert axes. With rules the train step runs on
+        the GSPMD path (single-device step code + operand shardings; XLA
+        inserts the collectives) instead of the shard_map DP path, so tensor
+        and expert parallelism compose with the ``data`` axis through this
+        same high-level API."""
         if mode not in ("streaming", "scan"):
             raise ValueError(f"mode must be 'streaming' or 'scan', got {mode!r}")
+        if sharding_rules is not None and mesh is None:
+            raise ValueError("sharding_rules requires a mesh")
         self.model = model
         self.optimizer = optimizer
         self.accum = accum
@@ -78,6 +89,7 @@ class Estimator:
         self.mesh = mesh
         self.mode = mode
         self.warm_start = warm_start
+        self.sharding_rules = sharding_rules
         self._train_step = None
         self._eval_step = None
         self._predict_fn = None
@@ -154,6 +166,17 @@ class Estimator:
             return jax.tree.map(jnp.asarray, state)
         return None
 
+    def _place_state(self, state):
+        """Lay the TrainState out per ``sharding_rules`` (no-op otherwise).
+        Idempotent — re-placing an already-sharded state is cheap — so it is
+        safe on every train() entry (fresh init, checkpoint restore, or a
+        state carried across train_and_evaluate chunks)."""
+        if self.mesh is None or self.sharding_rules is None:
+            return state
+        from gradaccum_tpu.parallel.sharding import shard_params
+
+        return shard_params(state, self.mesh, self.sharding_rules)
+
     # -- step builders ---------------------------------------------------
 
     def _build_train_step(self):
@@ -161,12 +184,17 @@ class Estimator:
             return self._train_step
         loss_fn = self._loss_fn()
         needs_rng = self.model.needs_rng
-        if self.mesh is not None:
+        if self.mesh is not None and self.sharding_rules is None:
             step = make_dp_train_step(
                 loss_fn, self.optimizer, self.accum, self.mesh,
                 mode=self.mode, needs_rng=needs_rng,
             )
         else:
+            # Single jit covers both the no-mesh case and the GSPMD path:
+            # with sharding_rules the state is pre-placed by the rules
+            # (:meth:`_place_state`) and the batch by ``device_put_batch``;
+            # jit propagates operand shardings and XLA inserts the
+            # collectives, so tp/ep axes compose with ``data`` for free.
             builder = (
                 acc.accumulate_scan if self.mode == "scan" else acc.streaming_step
             )
@@ -201,17 +229,26 @@ class Estimator:
         divide the data axis (the uneven final batch) run on the default
         device instead, keeping streaming-metric semantics exact."""
         from gradaccum_tpu.parallel.mesh import DATA_AXIS
-        from gradaccum_tpu.parallel.sharding import batch_sharding, replicated
+        from gradaccum_tpu.parallel.sharding import (
+            batch_sharding,
+            replicated,
+            shard_params,
+        )
 
         jitted = jax.jit(fn)
         n_data = dict(self.mesh.shape).get(DATA_AXIS, 1) if self.mesh else 1
-        if n_data <= 1:
+        if n_data <= 1 and self.sharding_rules is None:
             return jitted
         rep = replicated(self.mesh)
         shard = batch_sharding(self.mesh)
         # identity-keyed memo holding a strong ref to the key pytree (bare
         # id() could be recycled after the old params are freed)
         memo = {"source": None, "placed": None}
+
+        def place_params(params):
+            if self.sharding_rules is not None:
+                return shard_params(params, self.mesh, self.sharding_rules)
+            return jax.device_put(params, rep)
 
         def dispatch(params, batch):
             dims = {
@@ -228,7 +265,7 @@ class Estimator:
                 )
                 if memo["source"] is not params:
                     memo["source"] = params
-                    memo["placed"] = jax.device_put(params, rep)
+                    memo["placed"] = place_params(params)
                 params = memo["placed"]
             return jitted(params, batch)
 
@@ -281,6 +318,7 @@ class Estimator:
             restored = self._maybe_restore(state)
             if restored is not None:
                 state = restored
+        state = self._place_state(state)
         step_fn = self._build_train_step()
 
         k = self.accum.num_micro_batches if self.mode == "scan" else 1

