@@ -143,9 +143,6 @@ def main(argv=None):
                      "(pretrained dense FFN weights have no expert bank)")
     if min(args.dp, args.tp, args.ep) < 1:
         parser.error("--dp/--tp/--ep must be >= 1")
-    if args.tp > 1 and args.ep > 1:
-        parser.error("--tp and --ep cannot combine (one model-sharding rule "
-                     "set at a time; both compose with --dp)")
     if args.ep > 1 and (args.num_experts == 0 or args.num_experts % args.ep):
         parser.error("--ep requires --num-experts divisible by it")
 
@@ -257,22 +254,32 @@ def main(argv=None):
 
         if n_mesh > len(jax.devices()):
             parser.error(f"mesh needs {n_mesh} devices, have {len(jax.devices())}")
-        if args.tp > 1:
+        if args.tp > 1 and args.ep > 1:
+            from gradaccum_tpu.parallel.tp import bert_tp_ep_rules
+
+            mesh = make_mesh(data=args.dp, model=args.tp, expert=args.ep,
+                             devices=jax.devices()[:n_mesh])
+            rules = bert_tp_ep_rules()
+            kind = "tp+ep"
+        elif args.tp > 1:
             from gradaccum_tpu.parallel.tp import bert_tp_rules
 
             mesh = make_mesh(data=args.dp, model=args.tp,
                              devices=jax.devices()[:n_mesh])
             rules = bert_tp_rules()
+            kind = "tp"
         elif args.ep > 1:
             from gradaccum_tpu.models.moe import moe_ep_rules
 
             mesh = make_mesh(data=args.dp, expert=args.ep,
                              devices=jax.devices()[:n_mesh])
             rules = moe_ep_rules()
+            kind = "ep"
         else:  # pure DP: the shard_map path (explicit ring collectives)
             mesh = make_mesh(data=args.dp, devices=jax.devices()[:n_mesh])
+            kind = "dp"
         print(f"[mesh] {dict(mesh.shape)}"
-              + (f" rules={'tp' if args.tp > 1 else 'ep'}" if rules else ""))
+              + (f" rules={kind}" if rules else ""))
 
     est = gt.Estimator(
         bert_classifier_bundle(cfg, num_classes=2, attention_fn=attention_fn),

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from jax.sharding import PartitionSpec as P
 
-from gradaccum_tpu.parallel.mesh import MODEL_AXIS
+from gradaccum_tpu.parallel.mesh import EXPERT_AXIS, MODEL_AXIS
 
 
 def bert_tp_rules(axis: str = MODEL_AXIS):
@@ -31,3 +31,22 @@ def bert_tp_rules(axis: str = MODEL_AXIS):
         # big embedding table: shard the vocab dim
         (r"word_embeddings/embedding", P(axis, None)),
     ]
+
+
+def bert_tp_ep_rules(model_axis: str = MODEL_AXIS, expert_axis: str = EXPERT_AXIS):
+    """Combined 3-axis (data × model × expert) rules for a MoE-FFN BERT.
+
+    Attention/embedding shard Megatron-style over ``model`` (the
+    :func:`bert_tp_rules` patterns), and each expert-stacked FFN leaf shards
+    2-D: expert dim over ``expert``, the per-expert matmul Megatron-style
+    over ``model`` (column-parallel ``w_in``, row-parallel ``w_out``). The
+    pattern sets are disjoint — a MoE layer has no ``intermediate``/
+    ``ffn_output`` kernels — so first-match ordering never conflicts; the
+    router stays replicated.
+    """
+    return [
+        (r"w_in", P(expert_axis, None, model_axis)),
+        (r"b_in", P(expert_axis, model_axis)),
+        (r"w_out", P(expert_axis, model_axis, None)),
+        (r"b_out", P(expert_axis, None)),
+    ] + bert_tp_rules(model_axis)

@@ -15,7 +15,7 @@ import gradaccum_tpu as gt
 from gradaccum_tpu.models.bert import BertConfig, bert_classifier_bundle
 from gradaccum_tpu.models.moe import moe_ep_rules
 from gradaccum_tpu.parallel.mesh import make_mesh
-from gradaccum_tpu.parallel.tp import bert_tp_rules
+from gradaccum_tpu.parallel.tp import bert_tp_ep_rules, bert_tp_rules
 
 K = 2
 MICRO = 8  # divisible by the data axis in every mesh below
@@ -85,8 +85,9 @@ def _assert_params_close(a, b):
         ({}, bert_tp_rules(), dict(data=4, model=2)),
         ({}, bert_tp_rules(), dict(data=1, model=8)),
         ({"num_experts": 4}, moe_ep_rules(), dict(data=4, expert=2)),
+        ({"num_experts": 4}, bert_tp_ep_rules(), dict(data=2, model=2, expert=2)),
     ],
-    ids=["tp_dp4x2", "tp_pure_model8", "ep_dp4x2"],
+    ids=["tp_dp4x2", "tp_pure_model8", "ep_dp4x2", "tp_ep_3d_2x2x2"],
 )
 def test_estimator_sharding_rules_parity(rng, cfg_kw, rules, mesh_kw):
     cfg = BertConfig.tiny_for_tests(**cfg_kw)

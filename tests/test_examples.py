@@ -99,6 +99,7 @@ def test_bert_entrypoint_dp_tp_mesh_smoke(tmp_path):
 def test_bert_entrypoint_flag_validation():
     with pytest.raises(SystemExit):
         _run_example("bert_finetune", ["--ep", "2"])  # needs --num-experts
+    with pytest.raises(SystemExit):  # expert count must divide over --ep
+        _run_example("bert_finetune", ["--ep", "2", "--num-experts", "3"])
     with pytest.raises(SystemExit):
-        _run_example("bert_finetune", ["--tp", "2", "--ep", "2",
-                                       "--num-experts", "4"])
+        _run_example("bert_finetune", ["--dp", "0"])
