@@ -26,45 +26,13 @@ K, MICRO, SEQ = 4, 8, 128
 VOCAB = 30522
 NUM_CLASSES = 2
 
-# bf16 peak FLOP/s per chip by device_kind substring (public spec sheets).
-PEAK_FLOPS = [
-    ("v5 lite", 197e12),  # TPU v5e
-    ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v6", 918e12),  # Trillium
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
-
-
-def bert_train_flops_per_seq(hidden, layers, intermediate, seq, num_classes):
-    """Analytic fwd+bwd matmul FLOPs for one sequence.
-
-    Per token per layer: QKVO projections 4*(2*H*H) + FFN 2*(2*H*I);
-    attention scores+context 2*(2*S*H). Pooler + classifier per sequence.
-    Backward ~= 2x forward (grads w.r.t. both inputs and weights), so
-    train = 3x fwd. Embedding gather/scatter-add contribute ~0 matmul FLOPs.
-    """
-    per_tok = layers * (8 * hidden * hidden + 4 * hidden * intermediate
-                        + 4 * seq * hidden)
-    fwd = seq * per_tok + 2 * hidden * hidden + 2 * hidden * num_classes
-    return 3 * fwd
-
-
-def peak_flops_for(device_kind):
-    kind = device_kind.lower()
-    for sub, peak in PEAK_FLOPS:
-        if sub in kind:
-            return peak
-    return None
-
 
 def measure(iters, warmup):
     from gradaccum_tpu.utils.platform import honor_cpu_platform_request
 
     honor_cpu_platform_request()
 
+    from gradaccum_tpu.utils.flops import bert_train_flops_per_seq, peak_flops_for
     from gradaccum_tpu.utils.timing import configure_fast_prng, time_device_steps
 
     configure_fast_prng()

@@ -281,12 +281,17 @@ def main(argv=None):
         print(f"[mesh] {dict(mesh.shape)}"
               + (f" rules={kind}" if rules else ""))
 
+    from gradaccum_tpu.utils.flops import bert_train_flops_per_seq
+
     est = gt.Estimator(
         bert_classifier_bundle(cfg, num_classes=2, attention_fn=attention_fn),
         gt.ops.adamw(schedule, weight_decay_rate=0.01),  # optimization.py:59-65
         gt.GradAccumConfig(num_micro_batches=k, clip_norm=1.0,
                            first_step_quirk=True),  # optimization.py:76-94
-        gt.RunConfig(model_dir=model_dir, log_step_count_steps=max(max_steps // 20, 1)),
+        gt.RunConfig(model_dir=model_dir, log_step_count_steps=max(max_steps // 20, 1),
+                     flops_per_example=bert_train_flops_per_seq(
+                         cfg.hidden_size, cfg.num_layers, cfg.intermediate_size,
+                         args.seq_len, 2)),
         mode=args.mode,
         warm_start=pretrained,
         mesh=mesh,

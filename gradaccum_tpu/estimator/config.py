@@ -26,6 +26,10 @@ class RunConfig:
     profile_dir: Optional[str] = None
     profile_start_step: int = 10  # skip compile + warmup steps
     profile_num_steps: int = 5
+    # analytic fwd+bwd FLOPs per training example (see utils/flops.py, e.g.
+    # bert_train_flops_per_seq): when set and the device's bf16 peak is
+    # known, train logging reports MFU next to examples/sec
+    flops_per_example: Optional[float] = None
 
 
 @dataclass

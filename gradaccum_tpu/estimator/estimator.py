@@ -96,6 +96,7 @@ class Estimator:
         self._state = None  # last trained/restored state
         self._events = None  # lazy TensorBoard event writer (events.py)
         self._async_ckpt = None  # lazy AsyncCheckpointer (async_checkpoint)
+        self._peak_flops = None  # lazy mesh-wide bf16 peak (see _mfu)
 
     def _ckpt_save(self, state, step_no):
         """Route through the async writer when configured — training only
@@ -381,10 +382,14 @@ class Estimator:
                     dt = time.time() - t0
                     rate = (step_no - steps_at_t0) / max(dt, 1e-9)
                     loss = float(jax.device_get(aux["loss"]))
-                    print(
+                    line = (
                         f"[train] step={step_no} loss={loss:.5f} "
                         f"steps/sec={rate:.2f} examples/sec={rate * micro_size:.1f}"
                     )
+                    mfu = self._mfu(rate * micro_size)
+                    if mfu is not None:
+                        line += f" mfu={mfu:.4f}"
+                    print(line)
                     last_logged_bucket = bucket
                     flush_loss_rows()
                 if (
@@ -529,6 +534,28 @@ class Estimator:
         leaf = jax.tree.leaves(batch)[0]
         n = leaf.shape[0]
         return n // (self.accum.num_micro_batches if self.mode == "scan" else 1)
+
+    def _mfu(self, examples_per_sec):
+        """Model FLOPs utilization for the logged throughput, or None when
+        ``RunConfig.flops_per_example`` is unset or the device peak is
+        unknown (CPU test backend). Peak scales by the mesh's device count —
+        examples/sec is whole-mesh throughput."""
+        if self.config.flops_per_example is None:
+            return None
+        if self._peak_flops is None:
+            from gradaccum_tpu.utils.flops import peak_flops_for
+
+            devices = (
+                list(self.mesh.devices.flat) if self.mesh is not None
+                else [jax.devices()[0]]
+            )
+            per_chip = peak_flops_for(devices[0].device_kind)
+            self._peak_flops = (
+                per_chip * len(devices) if per_chip else float("nan")
+            )
+        if self._peak_flops != self._peak_flops:  # unknown device kind
+            return None
+        return examples_per_sec * self.config.flops_per_example / self._peak_flops
 
     def _params_for_inference(self, sample_batch, state, checkpoint_path):
         """(params, step) for evaluate/predict — step is the train step the

@@ -1,0 +1,56 @@
+"""Analytic FLOPs accounting and device peak lookup for MFU reporting.
+
+The reference publishes no throughput numbers at all (SURVEY.md §6); MFU —
+achieved matmul FLOP/s over the chip's bf16 peak — is the TPU-native
+observability equivalent, shared by ``bench.py`` and the Estimator's
+train-loop logging (``RunConfig.flops_per_example``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# bf16 peak FLOP/s per chip by device_kind substring (public spec sheets).
+# Ordered: first substring match wins, so "v5 lite"/"v5e" precede "v5p".
+PEAK_BF16_FLOPS = [
+    ("v5 lite", 197e12),  # TPU v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6", 918e12),  # Trillium
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def peak_flops_for(device_kind: str) -> Optional[float]:
+    """bf16 peak FLOP/s for a ``jax.Device.device_kind``; None if unknown
+    (e.g. the CPU test backend — callers should then omit MFU rather than
+    report a bogus number)."""
+    kind = device_kind.lower()
+    for sub, peak in PEAK_BF16_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def bert_train_flops_per_seq(
+    hidden: int,
+    layers: int,
+    intermediate: int,
+    seq: int,
+    num_classes: int,
+) -> float:
+    """Analytic fwd+bwd matmul FLOPs for one sequence of BERT fine-tuning.
+
+    Per token per layer: QKVO projections ``4*(2*H*H)`` + FFN ``2*(2*H*I)``;
+    attention scores+context ``2*(2*S*H)``. Pooler + classifier per
+    sequence. Backward ~= 2x forward (grads w.r.t. both inputs and
+    weights), so train = 3x fwd. Embedding gather/scatter-add contribute
+    ~0 matmul FLOPs.
+    """
+    per_tok = layers * (
+        8 * hidden * hidden + 4 * hidden * intermediate + 4 * seq * hidden
+    )
+    fwd = seq * per_tok + 2 * hidden * hidden + 2 * hidden * num_classes
+    return 3.0 * fwd

@@ -497,3 +497,30 @@ def test_eval_events_step_from_checkpoint(rng, tmp_path):
     acc = EventAccumulator(f"{model_dir}/standalone")
     acc.Reload()
     assert all(e.step == 12 for e in acc.Scalars("mae"))
+
+
+def test_mfu_accounting():
+    """utils/flops peak lookup + the Estimator's MFU arithmetic; on the CPU
+    test backend the device peak is unknown, so MFU must be omitted (None),
+    never a bogus number."""
+    from gradaccum_tpu.utils.flops import bert_train_flops_per_seq, peak_flops_for
+
+    # BERT-Small seq-128 value that bench.py's MFU reporting is built on
+    assert bert_train_flops_per_seq(512, 4, 2048, 128, 2) == 10067908608
+    assert peak_flops_for("TPU v5 lite") == 197e12
+    assert peak_flops_for("TPU v4") == 275e12
+    assert peak_flops_for("TPU v5p") == 459e12  # 'v5 lite' must not match it
+    assert peak_flops_for("cpu") is None
+
+    def fresh(**cfg_kw):
+        return Estimator(
+            _linear_bundle(), adam(5e-2), GradAccumConfig(num_micro_batches=K),
+            RunConfig(model_dir=None, **cfg_kw), mode="scan",
+        )
+
+    est = fresh(flops_per_example=1e9)
+    assert est._mfu(1000.0) is None  # cpu backend: unknown peak
+    # known peak: simple ratio, scaled by nothing else
+    est._peak_flops = 197e12
+    np.testing.assert_allclose(est._mfu(1000.0), 1e9 * 1000.0 / 197e12)
+    assert fresh()._mfu(1000.0) is None  # flops_per_example unset
