@@ -65,6 +65,7 @@ class Estimator:
         mode: str = "streaming",
         warm_start=None,
         sharding_rules=None,
+        eval_model: Optional[ModelBundle] = None,
     ):
         """``warm_start``: a params pytree used instead of ``model.init`` for
         fresh runs (tf.estimator's WarmStartSettings slot — how pretrained
@@ -77,11 +78,31 @@ class Estimator:
         the GSPMD path (single-device step code + operand shardings; XLA
         inserts the collectives) instead of the shard_map DP path, so tensor
         and expert parallelism compose with the ``data`` axis through this
-        same high-level API."""
+        same high-level API.
+
+        A mesh with a ``seq`` axis (> 1) selects the sequence-parallel train
+        step (:func:`parallel.sp.make_dp_sp_train_step`): the model must be
+        seq-aware (e.g. ``bert_classifier_bundle(..., seq_axis="seq",
+        attention_fn=make_ring_attention_fn("seq"))``), whose loss only runs
+        inside ``shard_map`` — so pass the dense twin (same param tree, no
+        axis binding) as ``eval_model`` for evaluate/predict."""
         if mode not in ("streaming", "scan"):
             raise ValueError(f"mode must be 'streaming' or 'scan', got {mode!r}")
         if sharding_rules is not None and mesh is None:
             raise ValueError("sharding_rules requires a mesh")
+        from gradaccum_tpu.parallel.mesh import SEQ_AXIS
+
+        self._sp_active = (
+            mesh is not None and dict(mesh.shape).get(SEQ_AXIS, 1) > 1
+        )
+        if self._sp_active:
+            if mode != "scan":
+                raise ValueError("a 'seq' mesh axis requires mode='scan'")
+            if sharding_rules is not None:
+                raise ValueError(
+                    "sharding_rules cannot combine with a 'seq' mesh axis "
+                    "(sequence parallelism runs on the shard_map path)"
+                )
         self.model = model
         self.optimizer = optimizer
         self.accum = accum
@@ -90,6 +111,7 @@ class Estimator:
         self.mode = mode
         self.warm_start = warm_start
         self.sharding_rules = sharding_rules
+        self.eval_model = eval_model if eval_model is not None else model
         self._train_step = None
         self._eval_step = None
         self._predict_fn = None
@@ -185,7 +207,14 @@ class Estimator:
             return self._train_step
         loss_fn = self._loss_fn()
         needs_rng = self.model.needs_rng
-        if self.mesh is not None and self.sharding_rules is None:
+        if self._sp_active:
+            from gradaccum_tpu.parallel.sp import make_dp_sp_train_step
+
+            step = make_dp_sp_train_step(
+                loss_fn, self.optimizer, self.accum, self.mesh,
+                needs_rng=needs_rng,
+            )
+        elif self.mesh is not None and self.sharding_rules is None:
             step = make_dp_train_step(
                 loss_fn, self.optimizer, self.accum, self.mesh,
                 mode=self.mode, needs_rng=needs_rng,
@@ -209,8 +238,8 @@ class Estimator:
     def _build_eval_step(self):
         if self._eval_step is not None:
             return self._eval_step
-        predict = self.model.predict
-        metrics = self.model.eval_metrics
+        predict = self.eval_model.predict
+        metrics = self.eval_model.eval_metrics
 
         def eval_step(params, batch):
             outputs = predict(params, batch)
@@ -278,7 +307,9 @@ class Estimator:
         """Returns the positional args after ``state`` for the train step."""
         if self.mode == "scan":
             batch = acc.stack_micro_batches(batch, self.accum.num_micro_batches)
-        if self.mesh is not None:
+        if self.mesh is not None and not self._sp_active:
+            # (sp step: shard_map in_specs place the host batch, including
+            # the token-dim split over 'seq' — pre-placement would fight it)
             batch = device_put_batch(
                 batch,
                 self.mesh,
@@ -443,7 +474,7 @@ class Estimator:
             batch = next(it, None)
 
         results = {
-            key: float(self.model.eval_metrics[key].finalize(jnp.asarray(t), jnp.asarray(c)))
+            key: float(self.eval_model.eval_metrics[key].finalize(jnp.asarray(t), jnp.asarray(c)))
             for key, (t, c) in totals.items()
         }
         print(f"[{name}] " + " ".join(f"{k}={v:.5f}" for k, v in results.items()))
@@ -464,7 +495,7 @@ class Estimator:
             return
         params, _ = self._params_for_inference(first, state, checkpoint_path)
         if self._predict_fn is None:
-            self._predict_fn = self._mesh_dispatch(self.model.predict)
+            self._predict_fn = self._mesh_dispatch(self.eval_model.predict)
         predict = self._predict_fn
         batch = first
         while batch is not None:

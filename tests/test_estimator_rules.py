@@ -175,3 +175,56 @@ def test_sharding_rules_require_mesh():
             gt.GradAccumConfig(num_micro_batches=K),
             sharding_rules=bert_tp_rules(),
         )
+
+
+def test_estimator_seq_axis_trains_and_evals(rng):
+    """A mesh with a 'seq' axis selects the dp×sp shard_map step; the dense
+    twin passed as eval_model makes evaluate/predict work on the same
+    params. Parity vs the plain single-device Estimator (test_sp.py's
+    invariant, but through the high-level API)."""
+    from gradaccum_tpu.parallel.ring_attention import make_ring_attention_fn
+
+    cfg = BertConfig.tiny_for_tests(hidden_dropout=0.0, attention_dropout=0.0)
+    train = _data(rng, cfg)
+    evald = _data(rng, cfg, n=N_EVAL)
+
+    dense = bert_classifier_bundle(cfg, num_classes=2)
+    sp_bundle = bert_classifier_bundle(
+        cfg, num_classes=2,
+        attention_fn=make_ring_attention_fn("seq"), seq_axis="seq",
+    )
+
+    def estimator(model, mesh=None, eval_model=None):
+        return gt.Estimator(
+            model,
+            gt.ops.adamw(1e-3, weight_decay_rate=0.01),
+            gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0),
+            gt.RunConfig(seed=7),
+            mesh=mesh, mode="scan", eval_model=eval_model,
+        )
+
+    ref = estimator(dense)
+    ref_state = ref.train(_train_fn(train), max_steps=MAX_STEPS)
+    ref_eval = ref.evaluate(_eval_fn(evald), state=ref_state)
+
+    mesh = make_mesh(data=4, seq=2, devices=jax.devices())
+    est = estimator(sp_bundle, mesh=mesh, eval_model=dense)
+    state = est.train(_train_fn(train), max_steps=MAX_STEPS)
+    _assert_params_close(state.params, ref_state.params)
+
+    res = est.evaluate(_eval_fn(evald), state=state)
+    np.testing.assert_allclose(res["accuracy"], ref_eval["accuracy"], rtol=1e-6)
+
+
+def test_estimator_seq_axis_rejects_bad_combos():
+    cfg = BertConfig.tiny_for_tests(hidden_dropout=0.0, attention_dropout=0.0)
+    bundle = bert_classifier_bundle(cfg, num_classes=2)
+    mesh = make_mesh(data=4, seq=2, devices=jax.devices())
+    with pytest.raises(ValueError, match="scan"):
+        gt.Estimator(bundle, gt.ops.adamw(1e-3),
+                     gt.GradAccumConfig(num_micro_batches=K),
+                     mesh=mesh, mode="streaming")
+    with pytest.raises(ValueError, match="seq"):
+        gt.Estimator(bundle, gt.ops.adamw(1e-3),
+                     gt.GradAccumConfig(num_micro_batches=K),
+                     mesh=mesh, mode="scan", sharding_rules=bert_tp_rules())
