@@ -1,0 +1,158 @@
+"""Pipeline-parallel BERT: the flagship model on the GPipe schedule.
+
+Partitions the dense :class:`gradaccum_tpu.models.bert.BertClassifier`
+parameter tree into the :class:`gradaccum_tpu.parallel.pp.PipelineParams`
+layout — embeddings as the pipe-replicated ``pre``, the encoder layer stack
+as homogeneous stages, pooler+classifier as the ``post`` head — and provides
+the matching ``pre_fn`` / ``stage_fn`` / ``loss_fn`` for
+:func:`gradaccum_tpu.parallel.pp.make_pp_train_step`. Parameter values are
+shared with the dense model (same names, regrouped), so a PP run can be
+checked leaf-for-leaf against single-device training and dense checkpoints
+(including HF imports via models/bert_checkpoint.py) pipeline without
+conversion.
+
+The reference has no pipeline parallelism at all (SURVEY.md §2 checklist);
+this composes the TPU-native PP extension with the reference's flagship
+fine-tune (/root/reference/README.md:60-78).
+
+Dropout must be 0 (PP stages run deterministically — standard for the
+schedule-exactness tests; the dense twin handles dropout runs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from gradaccum_tpu.models.bert import BertConfig, EncoderLayer
+
+EMBED_KEYS = (
+    "word_embeddings",
+    "position_embeddings",
+    "token_type_embeddings",
+    "embeddings_LayerNorm",
+)
+
+
+class BertEmbeddings(nn.Module):
+    """Embedding sum + LayerNorm, parameter names matching BertEncoder's so
+    dense trees regroup without renaming."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, segment_ids=None):
+        cfg = self.config
+        B, S = input_ids.shape
+        if segment_ids is None:
+            segment_ids = jnp.zeros((B, S), jnp.int32)
+        positions = jnp.arange(S)[None, :]
+        word = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                        name="word_embeddings")(input_ids)
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                       dtype=cfg.dtype, name="position_embeddings")(positions)
+        typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       name="token_type_embeddings")(segment_ids)
+        x = word + pos + typ
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                            name="embeddings_LayerNorm")(x)
+
+
+class BertStage(nn.Module):
+    """``layers_per_stage`` encoder layers, locally named ``sub_j`` so every
+    stage's parameter tree is structurally identical (stackable)."""
+
+    config: BertConfig
+    layers_per_stage: int
+
+    @nn.compact
+    def __call__(self, x, mask):
+        for j in range(self.layers_per_stage):
+            x = EncoderLayer(self.config, name=f"sub_{j}")(x, mask, True)
+        return x
+
+
+class BertHead(nn.Module):
+    config: BertConfig
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, cls):
+        cfg = self.config
+        pooled = jnp.tanh(
+            nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="pooler")(cls)
+        )
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="classifier")(
+            pooled.astype(jnp.float32)
+        )
+
+
+def bert_pp_partition(
+    dense_params: Any, n_stages: int
+) -> Tuple[Any, list, Any]:
+    """Regroup a dense ``BertClassifier`` param tree (``{"params": {"bert":
+    ..., "pooler": ..., "classifier": ...}}``) into ``(pre_params,
+    stage_params_list, post_params)``. Layer ``s*m + j`` becomes stage ``s``'s
+    ``sub_j`` (``m = L / n_stages``; L must divide evenly)."""
+    p = dense_params["params"]
+    bert = p["bert"]
+    layer_names = sorted(
+        (k for k in bert if k.startswith("layer_")),
+        key=lambda s: int(s.rsplit("_", 1)[1]),
+    )
+    L = len(layer_names)
+    if L % n_stages:
+        raise ValueError(f"{L} encoder layers do not split over {n_stages} stages")
+    m = L // n_stages
+    pre = {"params": {k: bert[k] for k in EMBED_KEYS}}
+    stages = [
+        {"params": {f"sub_{j}": bert[layer_names[s * m + j]] for j in range(m)}}
+        for s in range(n_stages)
+    ]
+    post = {"params": {"pooler": p["pooler"], "classifier": p["classifier"]}}
+    return pre, stages, post
+
+
+def bert_pp_fns(cfg: BertConfig, layers_per_stage: int, num_classes: int = 2):
+    """(pre_fn, stage_fn, loss_fn) for ``make_pp_train_step``.
+
+    ``stage_fn`` takes the attention mask via the pipeline ctx (pass
+    ``ctx_keys=("input_mask",)``; a missing mask means no padding).
+    ``loss_fn`` runs the pooler/classifier head on [CLS] and returns the
+    mean softmax cross-entropy — the dense bundle's loss without the MoE
+    term (PP stages are dense FFN).
+    """
+    if cfg.hidden_dropout > 0 or cfg.attention_dropout > 0:
+        raise ValueError(
+            "pipeline-parallel BERT requires hidden_dropout=0 and "
+            "attention_dropout=0"
+        )
+    if cfg.num_experts > 0:
+        raise ValueError("pipeline-parallel BERT supports dense FFN only")
+    embed = BertEmbeddings(cfg)
+    stage = BertStage(cfg, layers_per_stage)
+    head = BertHead(cfg, num_classes)
+
+    def pre_fn(pre_params, micro_batch):
+        return embed.apply(
+            pre_params, micro_batch["input_ids"], micro_batch.get("segment_ids")
+        )
+
+    def stage_fn(stage_params, x, ctx):
+        input_mask = ctx.get("input_mask")
+        if input_mask is None:
+            mask = None
+        else:
+            mask = (1.0 - input_mask[:, None, None, :].astype(jnp.float32)) * -1e9
+            mask = mask.astype(cfg.dtype)
+        return stage.apply(stage_params, x, mask)
+
+    def loss_fn(post_params, final_acts, labels):
+        logits = head.apply(post_params, final_acts[:, 0])
+        onehot = jax.nn.one_hot(labels["label"], num_classes)
+        return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+    return pre_fn, stage_fn, loss_fn

@@ -26,8 +26,14 @@ Mechanics (inside ``shard_map`` over ``pipe``, P stages, K micro-batches):
 
 Requirements: homogeneous stages (``stage_fn(stage_params, x) -> y`` with
 ``y.shape == x.shape``) — the transformer-layer-stack case. Embedding/head
-layers belong outside the pipelined region (run them replicated before/after,
-or fold them into the first/last stage with padding).
+layers sit outside the pipelined region as ``PipelineParams.pre`` /
+``.post``: replicated over ``pipe``, applied before rank 0's feed and
+inside the last rank's loss (``pre_fn`` / 3-arg ``loss_fn``), with their
+gradients psum'd onto the replicated copies by shard_map's vma-aware
+transpose. Per-micro-batch side inputs that every stage needs (e.g. the
+attention mask) ride along as ``ctx_keys``: each rank slices the micro
+batch it is currently holding (tick ``t`` → micro ``t - rank``). See
+:mod:`gradaccum_tpu.models.bert_pp` for the BERT instantiation.
 """
 
 from __future__ import annotations
@@ -49,9 +55,18 @@ PPLossFn = Callable[[jnp.ndarray, Any], jnp.ndarray]
 
 
 class PPState(NamedTuple):
-    params: Any  # stage-stacked [P, ...] per leaf
+    params: Any  # stage-stacked [P, ...] per leaf, or a PipelineParams
     opt_state: Any  # same stacking
     step: jnp.ndarray
+
+
+class PipelineParams(NamedTuple):
+    """Stage-stacked pipeline body plus pipe-replicated pre/post trees
+    (embeddings / head). ``pre``/``post`` may be None."""
+
+    pre: Any
+    stages: Any  # [P, ...] per leaf
+    post: Any
 
 
 def stack_stage_params(stage_params_list) -> Any:
@@ -59,8 +74,15 @@ def stack_stage_params(stage_params_list) -> Any:
     return jax.tree.map(lambda *leaves: jnp.stack(leaves), *stage_params_list)
 
 
-def pp_init(stage_params_list, optimizer: Optimizer) -> PPState:
+def pp_init(
+    stage_params_list,
+    optimizer: Optimizer,
+    pre_params: Any = None,
+    post_params: Any = None,
+) -> PPState:
     params = stack_stage_params(stage_params_list)
+    if pre_params is not None or post_params is not None:
+        params = PipelineParams(pre=pre_params, stages=params, post=post_params)
     return PPState(
         params=params,
         opt_state=optimizer.init(params),
@@ -73,12 +95,20 @@ def pipeline_apply(
     local_params: Any,
     micro_inputs: jnp.ndarray,
     axis: str = PIPE_AXIS,
+    micro_ctx: Any = None,
 ) -> jnp.ndarray:
     """Run the skewed GPipe schedule. Must run inside ``shard_map``.
 
     ``micro_inputs``: ``[K, B, ...]`` (replicated across the pipe axis);
     returns ``[K, B, ...]`` final-stage outputs, valid on the LAST rank
     (zeros elsewhere — mask or psum as needed).
+
+    ``micro_ctx``: optional pytree of ``[K, ...]`` per-micro-batch side
+    inputs every stage consumes alongside the traveling activations (e.g.
+    the attention mask). At tick ``t`` rank ``r`` holds micro-batch
+    ``t - r``, so each rank dynamic-slices that entry and ``stage_fn`` is
+    called as ``stage_fn(params, x, ctx)`` (bubble ticks clamp the index;
+    their outputs are discarded).
     """
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
@@ -91,7 +121,15 @@ def pipeline_apply(
     for t in range(ticks):  # static unroll: T is small (K + P - 1)
         feed = micro_inputs[t] if t < k else jnp.zeros_like(buf)
         x = jnp.where(idx == 0, feed, buf)
-        y = stage_fn(local_params, x)
+        if micro_ctx is None:
+            y = stage_fn(local_params, x)
+        else:
+            j = jnp.clip(t - idx, 0, k - 1)
+            ctx = jax.tree.map(
+                lambda l: lax.dynamic_index_in_dim(l, j, 0, keepdims=False),
+                micro_ctx,
+            )
+            y = stage_fn(local_params, x, ctx)
         if t >= n - 1:
             outs = outs.at[t - n + 1].set(
                 jnp.where(idx == n - 1, y, jnp.zeros_like(y))
@@ -110,6 +148,8 @@ def make_pp_train_step(
     axis: str = PIPE_AXIS,
     data_axis: str | None = None,
     input_key: str = "x",
+    pre_fn=None,
+    ctx_keys=(),
 ):
     """Build ``train_step(state, batch) -> (state, aux)``.
 
@@ -123,20 +163,52 @@ def make_pp_train_step(
     stage gradients are ``pmean``-ed across ``data`` before the update —
     GPipe × the reference's mirrored-worker DP (distributedExample/04:106)
     in one step function.
+
+    For states built with ``pp_init(..., pre_params=..., post_params=...)``
+    (a :class:`PipelineParams`):
+
+    - ``pre_fn(pre_params, micro_batch) -> [B, ...]`` maps each raw micro
+      batch to the pipeline's input activations (embeddings). It runs
+      replicated on every pipe rank (only rank 0's result is fed; the
+      redundant FLOPs are tiny next to the stage stack) and its gradient
+      arrives via shard_map's transpose-psum.
+    - ``loss_fn`` becomes 3-arg: ``loss_fn(post_params, final_acts,
+      labels) -> scalar`` — the head runs inside the last rank's loss.
+    - ``ctx_keys`` name batch leaves (stacked ``[K, ...]``) that every
+      stage needs per micro-batch (attention mask); see
+      :func:`pipeline_apply`.
     """
     k = num_micro_batches
 
     def step(state: PPState, batch):
         n = lax.axis_size(axis)
         idx = lax.axis_index(axis)
-        local_params = jax.tree.map(lambda p: p[0], state.params)
+        has_prepost = isinstance(state.params, PipelineParams)
+        stages = state.params.stages if has_prepost else state.params
+        local_stages = jax.tree.map(lambda p: p[0], stages)
+        diff_args = (
+            state.params.pre if has_prepost else None,
+            local_stages,
+            state.params.post if has_prepost else None,
+        )
 
-        def fwd(local_params):
-            outs = pipeline_apply(stage_fn, local_params, batch[input_key], axis)
+        def fwd(diff):
+            pre, local_params, post = diff
+            if pre_fn is not None:
+                micro_inputs = jax.vmap(lambda mb: pre_fn(pre, mb))(batch)
+            else:
+                micro_inputs = batch[input_key]
+            ctx = {key: batch[key] for key in ctx_keys} if ctx_keys else None
+            outs = pipeline_apply(stage_fn, local_params, micro_inputs, axis, ctx)
             labels = {key: v for key, v in batch.items() if key != input_key}
-            losses = jax.vmap(
-                lambda out, lbl: loss_fn(out, lbl)
-            )(outs, labels)
+            if has_prepost:
+                losses = jax.vmap(
+                    lambda out, lbl: loss_fn(post, out, lbl)
+                )(outs, labels)
+            else:
+                losses = jax.vmap(
+                    lambda out, lbl: loss_fn(out, lbl)
+                )(outs, labels)
             local = jnp.mean(losses)
             # only the last rank saw real outputs; broadcast its loss
             pipe_loss = lax.psum(jnp.where(idx == n - 1, local, 0.0), axis)
@@ -148,9 +220,12 @@ def make_pp_train_step(
             # data-replicated params — a post-hoc pmean would double-count)
             return lax.pmean(pipe_loss, data_axis)
 
-        loss, local_grads = jax.value_and_grad(fwd)(local_params)
+        loss, (g_pre, g_stages, g_post) = jax.value_and_grad(fwd)(diff_args)
         # re-stack to the [1, ...] local slice of the stage-stacked layout
-        grads = jax.tree.map(lambda g: g[None], local_grads)
+        g_stages = jax.tree.map(lambda g: g[None], g_stages)
+        grads = (
+            PipelineParams(g_pre, g_stages, g_post) if has_prepost else g_stages
+        )
         apply_step = state.step + k
         new_params, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params, apply_step
@@ -169,18 +244,37 @@ def make_pp_train_step(
         nothing is computed): a leaf is stage-stacked iff its shape is
         exactly ``(P,) + single_stage_shape``. A replicated leaf that merely
         happens to have leading dim P (e.g. a length-P schedule table) keeps
-        its single-stage shape under init and is correctly replicated."""
-        single_params = jax.tree.map(
-            lambda p: jax.ShapeDtypeStruct(p.shape[1:], p.dtype), state.params
-        )
+        its single-stage shape under init and is correctly replicated.
+        ``PipelineParams.pre``/``.post`` keep their full shapes in the
+        single-stage template, so they (and their opt-state moments) land on
+        the replicated branch of the same comparison."""
+
+        def single_leaf(p):
+            return jax.ShapeDtypeStruct(p.shape[1:], p.dtype)
+
+        if isinstance(state.params, PipelineParams):
+            ident = lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype)
+            single_params = PipelineParams(
+                pre=jax.tree.map(ident, state.params.pre),
+                stages=jax.tree.map(single_leaf, state.params.stages),
+                post=jax.tree.map(ident, state.params.post),
+            )
+            params_spec = PipelineParams(
+                pre=jax.tree.map(lambda _: P(), state.params.pre),
+                stages=jax.tree.map(lambda _: P(axis), state.params.stages),
+                post=jax.tree.map(lambda _: P(), state.params.post),
+            )
+        else:
+            single_params = jax.tree.map(single_leaf, state.params)
+            params_spec = jax.tree.map(lambda _: P(axis), state.params)
         single_opt = jax.eval_shape(optimizer.init, single_params)
 
-        def opt_spec(leaf, single_leaf):
-            stacked = tuple(leaf.shape) == (n_stages,) + tuple(single_leaf.shape)
+        def opt_spec(leaf, single):
+            stacked = tuple(leaf.shape) == (n_stages,) + tuple(single.shape)
             return P(axis) if stacked else P()
 
         return PPState(
-            params=jax.tree.map(lambda _: P(axis), state.params),
+            params=params_spec,
             opt_state=jax.tree.map(opt_spec, state.opt_state, single_opt),
             step=P(),
         )

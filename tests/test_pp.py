@@ -230,3 +230,109 @@ def test_pp_replicated_length_p_opt_leaf_not_sharded(rng):
         jax.device_get(state.params),
         jax.device_get(ref_params),
     )
+
+
+# -- BERT on the pipeline (models/bert_pp.py) ---------------------------------
+
+
+def _bert_pp_setup(rng, n_stages=2):
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.models.bert import BertConfig, bert_classifier_bundle
+    from gradaccum_tpu.models.bert_pp import bert_pp_fns, bert_pp_partition
+
+    cfg = BertConfig.tiny_for_tests(hidden_dropout=0.0, attention_dropout=0.0)
+    K, micro, S = 4, 8, 16
+    np_rng = np.random.default_rng(3)
+    batch = {
+        "input_ids": np_rng.integers(0, cfg.vocab_size, size=(K * micro, S)).astype(np.int32),
+        "input_mask": np.ones((K * micro, S), np.int32),
+        "segment_ids": np.zeros((K * micro, S), np.int32),
+        "label": np_rng.integers(0, 2, size=(K * micro,)).astype(np.int32),
+    }
+    batch["input_mask"][0, S - 4:] = 0  # padded tail: the ctx path must carry it
+    bundle = bert_classifier_bundle(cfg, num_classes=2)
+    dense_params = bundle.init(jax.random.PRNGKey(0), batch)
+    fns = bert_pp_fns(cfg, layers_per_stage=cfg.num_layers // n_stages)
+    parts = bert_pp_partition(dense_params, n_stages)
+    return gt, cfg, bundle, dense_params, batch, fns, parts, K
+
+
+@pytest.mark.parametrize("pipe,dp", [(2, 1), (2, 4)])
+def test_bert_pipeline_matches_dense_training(rng, pipe, dp):
+    """The flagship model on the GPipe schedule: N train steps of
+    pipeline-parallel BERT (embeddings as pre, layer stack as stages, head
+    in the last-rank loss, mask via ctx) match dense accumulate_scan
+    training leaf-for-leaf."""
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.models.bert_pp import bert_pp_partition
+    from gradaccum_tpu.ops.accumulation import scan_init
+
+    gt, cfg, bundle, dense_params, batch, fns, parts, K = _bert_pp_setup(rng, pipe)
+    pre_fn, stage_fn_b, loss_fn_b = fns
+    pre, stages, post = parts
+    opt = adamw(1e-3, weight_decay_rate=0.01)
+    n_steps = 3
+
+    # dense reference: scan-mode accumulation, no clip, deterministic rng
+    ref_step = jax.jit(
+        gt.accumulate_scan(
+            bundle.loss, opt,
+            gt.GradAccumConfig(num_micro_batches=K),
+            needs_rng=True,
+        )
+    )
+    stacked = gt.stack_micro_batches(batch, K)
+    ref_state = scan_init(dense_params, opt)
+    ref_losses = []
+    for i in range(n_steps):
+        ref_state, aux = ref_step(ref_state, stacked, jax.random.PRNGKey(9))
+        ref_losses.append(float(jax.device_get(aux["loss"])))
+
+    mesh = (
+        make_mesh(pipe=pipe, data=dp, devices=jax.devices()[: pipe * dp])
+    )
+    step = make_pp_train_step(
+        stage_fn_b, loss_fn_b, opt, K, mesh,
+        data_axis="data" if dp > 1 else None,
+        input_key="input_ids",
+        pre_fn=pre_fn,
+        ctx_keys=("input_mask",),
+    )
+    state = pp_init(stages, opt, pre_params=pre, post_params=post)
+    pp_losses = []
+    for i in range(n_steps):
+        state, aux = step(state, stacked)
+        pp_losses.append(float(jax.device_get(aux["loss"])))
+
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-5)
+    assert int(jax.device_get(state.step)) == n_steps * K
+
+    # leaf-for-leaf: regroup the dense reference's trained params the same way
+    ref_pre, ref_stages, ref_post = bert_pp_partition(
+        jax.device_get(ref_state.params), pipe
+    )
+    got = jax.device_get(state.params)
+    close = lambda a, b: jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=2e-4, atol=2e-5
+        ), a, b,
+    )
+    close(got.pre, ref_pre)
+    close(got.post, ref_post)
+    from gradaccum_tpu.parallel.pp import stack_stage_params as _stack
+    close(got.stages, jax.device_get(_stack(ref_stages)))
+
+
+def test_bert_pp_rejects_dropout_and_moe(rng):
+    from gradaccum_tpu.models.bert import BertConfig
+    from gradaccum_tpu.models.bert_pp import bert_pp_fns
+
+    with pytest.raises(ValueError, match="dropout"):
+        bert_pp_fns(BertConfig.tiny_for_tests(), layers_per_stage=1)
+    with pytest.raises(ValueError, match="dense FFN"):
+        bert_pp_fns(
+            BertConfig.tiny_for_tests(
+                hidden_dropout=0.0, attention_dropout=0.0, num_experts=2
+            ),
+            layers_per_stage=1,
+        )
