@@ -122,6 +122,17 @@ def main(argv=None):
         help="expert-parallel width: shard the MoE expert bank over an "
              "'expert' axis (moe_ep_rules; requires --num-experts)",
     )
+    parser.add_argument(
+        "--sp", type=int, default=1,
+        help="sequence-parallel width: shard the token dim over a 'seq' "
+             "axis (long-context training; composes with --dp, forces "
+             "dropout=0, excludes --tp/--ep)",
+    )
+    parser.add_argument(
+        "--sp-core", choices=["ring", "ulysses"], default="ring",
+        help="sequence-parallel attention layout: ring (ppermute K/V hops) "
+             "or ulysses (all_to_all seq<->heads repartition)",
+    )
     parser.add_argument("--full", action="store_true",
                         help="reference scale: 3 epochs over the corpus")
     parser.add_argument(
@@ -141,10 +152,16 @@ def main(argv=None):
     if args.hf_checkpoint and args.num_experts:
         parser.error("--num-experts cannot combine with --hf-checkpoint "
                      "(pretrained dense FFN weights have no expert bank)")
-    if min(args.dp, args.tp, args.ep) < 1:
-        parser.error("--dp/--tp/--ep must be >= 1")
+    if min(args.dp, args.tp, args.ep, args.sp) < 1:
+        parser.error("--dp/--tp/--ep/--sp must be >= 1")
     if args.ep > 1 and (args.num_experts == 0 or args.num_experts % args.ep):
         parser.error("--ep requires --num-experts divisible by it")
+    if args.sp > 1 and (args.tp > 1 or args.ep > 1):
+        parser.error("--sp composes with --dp only (shard_map path)")
+    if args.sp > 1 and args.mode != "scan":
+        parser.error("--sp requires --mode scan")
+    if args.sp > 1 and args.seq_len % args.sp:
+        parser.error(f"--seq-len {args.seq_len} not divisible by --sp {args.sp}")
 
     import jax.numpy as jnp
     import numpy as np
@@ -238,6 +255,12 @@ def main(argv=None):
                 "need a model trained with a larger position embedding"
             )
         overrides["max_position_embeddings"] = args.seq_len
+    if args.sp > 1:
+        if args.flash:
+            parser.error("--sp brings its own attention core; drop --flash")
+        # sequence-parallel BERT requires deterministic layers (sp.py docstring)
+        overrides["hidden_dropout"] = 0.0
+        overrides["attention_dropout"] = 0.0
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     attention_fn = flash_attention if args.flash else dense_attention
@@ -246,7 +269,7 @@ def main(argv=None):
         num_warmup_steps=int(max_steps * args.warmup_frac),
     )
     mesh, rules = None, None
-    n_mesh = args.dp * args.tp * args.ep
+    n_mesh = args.dp * args.tp * args.ep * args.sp
     if n_mesh > 1:
         import jax
 
@@ -254,7 +277,11 @@ def main(argv=None):
 
         if n_mesh > len(jax.devices()):
             parser.error(f"mesh needs {n_mesh} devices, have {len(jax.devices())}")
-        if args.tp > 1 and args.ep > 1:
+        if args.sp > 1:
+            mesh = make_mesh(data=args.dp, seq=args.sp,
+                             devices=jax.devices()[:n_mesh])
+            kind = f"sp[{args.sp_core}]"
+        elif args.tp > 1 and args.ep > 1:
             from gradaccum_tpu.parallel.tp import bert_tp_ep_rules
 
             mesh = make_mesh(data=args.dp, model=args.tp, expert=args.ep,
@@ -283,8 +310,27 @@ def main(argv=None):
 
     from gradaccum_tpu.utils.flops import bert_train_flops_per_seq
 
+    eval_bundle = None
+    if args.sp > 1:
+        from gradaccum_tpu.parallel.ring_attention import make_ring_attention_fn
+        from gradaccum_tpu.parallel.ulysses import make_ulysses_attention_fn
+
+        core = (
+            make_ring_attention_fn("seq") if args.sp_core == "ring"
+            else make_ulysses_attention_fn("seq")
+        )
+        train_bundle = bert_classifier_bundle(
+            cfg, num_classes=2, attention_fn=core, seq_axis="seq"
+        )
+        # dense twin: same param tree, no axis binding — serves eval/predict
+        eval_bundle = bert_classifier_bundle(cfg, num_classes=2)
+    else:
+        train_bundle = bert_classifier_bundle(
+            cfg, num_classes=2, attention_fn=attention_fn
+        )
+
     est = gt.Estimator(
-        bert_classifier_bundle(cfg, num_classes=2, attention_fn=attention_fn),
+        train_bundle,
         gt.ops.adamw(schedule, weight_decay_rate=0.01),  # optimization.py:59-65
         gt.GradAccumConfig(num_micro_batches=k, clip_norm=1.0,
                            first_step_quirk=True),  # optimization.py:76-94
@@ -296,6 +342,7 @@ def main(argv=None):
         warm_start=pretrained,
         mesh=mesh,
         sharding_rules=rules,
+        eval_model=eval_bundle,
     )
 
     # per-device micro-batch × data-parallel width (mnist 03/04 semantics:

@@ -96,6 +96,17 @@ def test_bert_entrypoint_dp_tp_mesh_smoke(tmp_path):
     assert 0.0 <= res["accuracy"] <= 1.0
 
 
+def test_bert_entrypoint_sp_mesh_smoke(tmp_path):
+    """--sp shards the token dim over a 'seq' axis (ring attention) with the
+    dense twin serving eval (numerics pinned by test_estimator_rules)."""
+    res = _run_example("bert_finetune", [
+        "--task", "cola", "--accum-k", "2", "--max-steps", "4",
+        "--seq-len", "32", "--dp", "2", "--sp", "2",
+        "--model-dir", str(tmp_path / "b"),
+    ])
+    assert 0.0 <= res["accuracy"] <= 1.0
+
+
 def test_bert_entrypoint_flag_validation():
     with pytest.raises(SystemExit):
         _run_example("bert_finetune", ["--ep", "2"])  # needs --num-experts
@@ -103,3 +114,7 @@ def test_bert_entrypoint_flag_validation():
         _run_example("bert_finetune", ["--ep", "2", "--num-experts", "3"])
     with pytest.raises(SystemExit):
         _run_example("bert_finetune", ["--dp", "0"])
+    with pytest.raises(SystemExit):  # sp excludes tp/ep
+        _run_example("bert_finetune", ["--sp", "2", "--tp", "2"])
+    with pytest.raises(SystemExit):  # seq len must split over sp
+        _run_example("bert_finetune", ["--sp", "3", "--seq-len", "32"])
