@@ -133,6 +133,12 @@ def main(argv=None):
         help="sequence-parallel attention layout: ring (ppermute K/V hops) "
              "or ulysses (all_to_all seq<->heads repartition)",
     )
+    parser.add_argument(
+        "--pp", type=int, default=1,
+        help="pipeline-parallel stages: GPipe over a 'pipe' axis, the K "
+             "accumulation micro-batches doubling as pipeline micro-batches "
+             "(composes with --dp; forces dropout=0, excludes --tp/--ep/--sp)",
+    )
     parser.add_argument("--full", action="store_true",
                         help="reference scale: 3 epochs over the corpus")
     parser.add_argument(
@@ -162,6 +168,10 @@ def main(argv=None):
         parser.error("--sp requires --mode scan")
     if args.sp > 1 and args.seq_len % args.sp:
         parser.error(f"--seq-len {args.seq_len} not divisible by --sp {args.sp}")
+    if args.pp > 1 and (args.tp > 1 or args.ep > 1 or args.sp > 1):
+        parser.error("--pp composes with --dp only")
+    if args.pp > 1 and args.mode != "scan":
+        parser.error("--pp requires --mode scan")
 
     import jax.numpy as jnp
     import numpy as np
@@ -261,6 +271,13 @@ def main(argv=None):
         # sequence-parallel BERT requires deterministic layers (sp.py docstring)
         overrides["hidden_dropout"] = 0.0
         overrides["attention_dropout"] = 0.0
+    if args.pp > 1:
+        if args.flash:
+            parser.error("--pp runs the dense stage core; drop --flash")
+        if cfg.num_layers % args.pp:
+            parser.error(f"{cfg.num_layers} layers do not split over --pp {args.pp}")
+        overrides["hidden_dropout"] = 0.0
+        overrides["attention_dropout"] = 0.0
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     attention_fn = flash_attention if args.flash else dense_attention
@@ -269,7 +286,7 @@ def main(argv=None):
         num_warmup_steps=int(max_steps * args.warmup_frac),
     )
     mesh, rules = None, None
-    n_mesh = args.dp * args.tp * args.ep * args.sp
+    n_mesh = args.dp * args.tp * args.ep * args.sp * args.pp
     if n_mesh > 1:
         import jax
 
@@ -277,7 +294,11 @@ def main(argv=None):
 
         if n_mesh > len(jax.devices()):
             parser.error(f"mesh needs {n_mesh} devices, have {len(jax.devices())}")
-        if args.sp > 1:
+        if args.pp > 1:
+            mesh = make_mesh(pipe=args.pp, data=args.dp,
+                             devices=jax.devices()[:n_mesh])
+            kind = "pp"
+        elif args.sp > 1:
             mesh = make_mesh(data=args.dp, seq=args.sp,
                              devices=jax.devices()[:n_mesh])
             kind = f"sp[{args.sp_core}]"
@@ -309,6 +330,12 @@ def main(argv=None):
               + (f" rules={kind}" if rules else ""))
 
     from gradaccum_tpu.utils.flops import bert_train_flops_per_seq
+
+    pipeline = None
+    if args.pp > 1:
+        from gradaccum_tpu.models.bert_pp import bert_pipeline_spec
+
+        pipeline = bert_pipeline_spec(cfg, n_stages=args.pp)
 
     eval_bundle = None
     if args.sp > 1:
@@ -343,6 +370,7 @@ def main(argv=None):
         mesh=mesh,
         sharding_rules=rules,
         eval_model=eval_bundle,
+        pipeline=pipeline,
     )
 
     # per-device micro-batch × data-parallel width (mnist 03/04 semantics:

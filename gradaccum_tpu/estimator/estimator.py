@@ -66,6 +66,7 @@ class Estimator:
         warm_start=None,
         sharding_rules=None,
         eval_model: Optional[ModelBundle] = None,
+        pipeline=None,
     ):
         """``warm_start``: a params pytree used instead of ``model.init`` for
         fresh runs (tf.estimator's WarmStartSettings slot — how pretrained
@@ -85,7 +86,15 @@ class Estimator:
         seq-aware (e.g. ``bert_classifier_bundle(..., seq_axis="seq",
         attention_fn=make_ring_attention_fn("seq"))``), whose loss only runs
         inside ``shard_map`` — so pass the dense twin (same param tree, no
-        axis binding) as ``eval_model`` for evaluate/predict."""
+        axis binding) as ``eval_model`` for evaluate/predict.
+
+        ``pipeline``: a :class:`parallel.pp.PipelineSpec` (e.g.
+        ``bert_pipeline_spec``) runs training on the GPipe schedule over the
+        mesh's ``pipe`` axis (× ``data``): ``model.init``'s dense tree is
+        partitioned into stages, the accumulation K doubles as the pipeline
+        micro-batch count, ``clip_norm`` applies globally across stages,
+        and evaluate/predict merge the trained stages back into the dense
+        tree (so the plain ``model``/``eval_model`` serves them)."""
         if mode not in ("streaming", "scan"):
             raise ValueError(f"mode must be 'streaming' or 'scan', got {mode!r}")
         if sharding_rules is not None and mesh is None:
@@ -103,6 +112,19 @@ class Estimator:
                     "sharding_rules cannot combine with a 'seq' mesh axis "
                     "(sequence parallelism runs on the shard_map path)"
                 )
+        if pipeline is not None:
+            from gradaccum_tpu.parallel.mesh import PIPE_AXIS
+
+            if mesh is None or dict(mesh.shape).get(PIPE_AXIS, 1) < 2:
+                raise ValueError("pipeline requires a mesh with a 'pipe' axis")
+            if mode != "scan":
+                raise ValueError("pipeline requires mode='scan' (K pipeline "
+                                 "micro-batches per host step)")
+            if sharding_rules is not None or self._sp_active:
+                raise ValueError(
+                    "pipeline composes with the 'data' axis only (no "
+                    "sharding_rules / 'seq' axis)"
+                )
         self.model = model
         self.optimizer = optimizer
         self.accum = accum
@@ -112,6 +134,7 @@ class Estimator:
         self.warm_start = warm_start
         self.sharding_rules = sharding_rules
         self.eval_model = eval_model if eval_model is not None else model
+        self.pipeline = pipeline
         self._train_step = None
         self._eval_step = None
         self._predict_fn = None
@@ -177,6 +200,14 @@ class Estimator:
         else:
             rng = jax.random.PRNGKey(self.config.seed)
             params = self.model.init(rng, sample_batch)
+        if self.pipeline is not None:
+            from gradaccum_tpu.parallel.pp import pp_init
+
+            pre, stages, post = self.pipeline.partition(
+                params, self.pipeline.n_stages
+            )
+            return pp_init(stages, self.optimizer,
+                           pre_params=pre, post_params=post)
         if self.mode == "scan":
             return acc.scan_init(params, self.optimizer)
         return acc.streaming_init(params, self.optimizer)
@@ -207,7 +238,22 @@ class Estimator:
             return self._train_step
         loss_fn = self._loss_fn()
         needs_rng = self.model.needs_rng
-        if self._sp_active:
+        if self.pipeline is not None:
+            from gradaccum_tpu.parallel.mesh import DATA_AXIS
+            from gradaccum_tpu.parallel.pp import make_pp_train_step
+
+            spec = self.pipeline
+            n_data = dict(self.mesh.shape).get(DATA_AXIS, 1)
+            step = make_pp_train_step(
+                spec.stage_fn, spec.loss_fn, self.optimizer,
+                self.accum.num_micro_batches, self.mesh,
+                data_axis=DATA_AXIS if n_data > 1 else None,
+                input_key=spec.input_key,
+                pre_fn=spec.pre_fn,
+                ctx_keys=tuple(spec.ctx_keys),
+                clip_norm=self.accum.clip_norm,
+            )
+        elif self._sp_active:
             from gradaccum_tpu.parallel.sp import make_dp_sp_train_step
 
             step = make_dp_sp_train_step(
@@ -307,14 +353,17 @@ class Estimator:
         """Returns the positional args after ``state`` for the train step."""
         if self.mode == "scan":
             batch = acc.stack_micro_batches(batch, self.accum.num_micro_batches)
-        if self.mesh is not None and not self._sp_active:
-            # (sp step: shard_map in_specs place the host batch, including
-            # the token-dim split over 'seq' — pre-placement would fight it)
+        if self.mesh is not None and not self._sp_active and self.pipeline is None:
+            # (sp/pp steps: shard_map in_specs place the host batch — the
+            # token-dim split over 'seq', stage specs over 'pipe' — so
+            # pre-placement would fight them)
             batch = device_put_batch(
                 batch,
                 self.mesh,
                 leading_unsharded=1 if self.mode == "scan" else 0,
             )
+        if self.pipeline is not None:
+            return (batch,)  # PP stages run deterministically: no rng arg
         if self.model.needs_rng:
             rng = jax.random.fold_in(
                 jax.random.PRNGKey(self.config.seed + 1), step_no
@@ -591,10 +640,18 @@ class Estimator:
     def _params_for_inference(self, sample_batch, state, checkpoint_path):
         """(params, step) for evaluate/predict — step is the train step the
         params correspond to (0 only for a genuinely fresh model), so eval
-        events land at the right x-coordinate in TensorBoard."""
+        events land at the right x-coordinate in TensorBoard. Pipeline
+        states merge back into the dense tree here (``PipelineSpec.merge``),
+        so the plain model bundle serves inference."""
+
+        def dense(params):
+            if self.pipeline is not None:
+                return self.pipeline.merge(params)
+            return params
+
         self._ckpt_sync()
         if state is not None:
-            return state.params, int(jax.device_get(state.step))
+            return dense(state.params), int(jax.device_get(state.step))
         if checkpoint_path or (
             self.config.model_dir and ckpt_lib.latest_checkpoint(self.config.model_dir)
         ):
@@ -605,12 +662,12 @@ class Estimator:
                 checkpoint_path or self.config.model_dir, template
             )
             return (
-                jax.tree.map(jnp.asarray, restored.params),
+                jax.tree.map(jnp.asarray, dense(restored.params)),
                 int(restored.step),
             )
         if self._state is not None:
-            return self._state.params, int(jax.device_get(self._state.step))
-        return self._init_state(self._sample_micro(sample_batch)).params, 0
+            return dense(self._state.params), int(jax.device_get(self._state.step))
+        return dense(self._init_state(self._sample_micro(sample_batch)).params), 0
 
     def _append_loss_csv(self, rows):
         """loss-vs-step CSV — the data behind the reference's PNG curves —

@@ -116,6 +116,52 @@ def bert_pp_partition(
     return pre, stages, post
 
 
+def bert_pp_merge(params) -> Any:
+    """Inverse of :func:`bert_pp_partition`: rebuild the dense
+    ``BertClassifier`` tree from a trained ``PipelineParams`` (host or
+    device arrays) — stage ``s``'s ``sub_j`` becomes ``layer_{s*m+j}`` —
+    so the dense twin can evaluate/predict pipeline-trained weights."""
+    pre = params.pre["params"]
+    post = params.post["params"]
+    stacked = params.stages["params"]
+    sub_names = sorted(stacked, key=lambda s: int(s.rsplit("_", 1)[1]))
+    m = len(sub_names)
+    n_stages = jax.tree.leaves(stacked)[0].shape[0]
+    bert = {k: pre[k] for k in EMBED_KEYS}
+    for s in range(n_stages):
+        for j, sub in enumerate(sub_names):
+            bert[f"layer_{s * m + j}"] = jax.tree.map(
+                lambda l: l[s], stacked[sub]
+            )
+    return {"params": {"bert": bert, "pooler": post["pooler"],
+                       "classifier": post["classifier"]}}
+
+
+def bert_pipeline_spec(cfg: BertConfig, n_stages: int, num_classes: int = 2):
+    """:class:`gradaccum_tpu.parallel.pp.PipelineSpec` for running BERT on
+    the pipeline through the Estimator (``Estimator(..., mesh=<pipe mesh>,
+    pipeline=bert_pipeline_spec(...))``)."""
+    from gradaccum_tpu.parallel.pp import PipelineSpec
+
+    if cfg.num_layers % n_stages:
+        raise ValueError(
+            f"{cfg.num_layers} encoder layers do not split over {n_stages} stages"
+        )
+    pre_fn, stage_fn, loss_fn = bert_pp_fns(
+        cfg, cfg.num_layers // n_stages, num_classes
+    )
+    return PipelineSpec(
+        n_stages=n_stages,
+        partition=bert_pp_partition,
+        merge=bert_pp_merge,
+        pre_fn=pre_fn,
+        stage_fn=stage_fn,
+        loss_fn=loss_fn,
+        input_key="input_ids",
+        ctx_keys=("input_mask",),
+    )
+
+
 def bert_pp_fns(cfg: BertConfig, layers_per_stage: int, num_classes: int = 2):
     """(pre_fn, stage_fn, loss_fn) for ``make_pp_train_step``.
 

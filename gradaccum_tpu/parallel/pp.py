@@ -38,7 +38,7 @@ batch it is currently holding (tick ``t`` → micro ``t - rank``). See
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple
+from typing import Any, Callable, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -67,6 +67,23 @@ class PipelineParams(NamedTuple):
     pre: Any
     stages: Any  # [P, ...] per leaf
     post: Any
+
+
+class PipelineSpec(NamedTuple):
+    """Everything the Estimator needs to run a model on the pipeline:
+    how to split a dense parameter tree into the PipelineParams layout
+    (``partition``), how to merge it back for evaluate/predict (``merge``),
+    and the three step functions. See
+    :func:`gradaccum_tpu.models.bert_pp.bert_pipeline_spec`."""
+
+    n_stages: int
+    partition: Callable[[Any, int], Tuple[Any, list, Any]]
+    merge: Callable[[PipelineParams], Any]
+    pre_fn: Callable
+    stage_fn: StageFn
+    loss_fn: Callable  # (post_params, final_acts, labels) -> scalar
+    input_key: str = "x"
+    ctx_keys: Sequence[str] = ()
 
 
 def stack_stage_params(stage_params_list) -> Any:
@@ -150,6 +167,7 @@ def make_pp_train_step(
     input_key: str = "x",
     pre_fn=None,
     ctx_keys=(),
+    clip_norm: float | None = None,
 ):
     """Build ``train_step(state, batch) -> (state, aux)``.
 
@@ -177,6 +195,11 @@ def make_pp_train_step(
     - ``ctx_keys`` name batch leaves (stacked ``[K, ...]``) that every
       stage needs per micro-batch (attention mask); see
       :func:`pipeline_apply`.
+
+    ``clip_norm``: global-norm clip of the (averaged) gradients before the
+    update — the BERT flavor's clip-after-average (optimization.py:83-85)
+    under PP. The squared norm sums each rank's local stage slice, psums
+    over ``pipe``, and adds the pipe-replicated pre/post contribution once.
     """
     k = num_micro_batches
 
@@ -221,6 +244,21 @@ def make_pp_train_step(
             return lax.pmean(pipe_loss, data_axis)
 
         loss, (g_pre, g_stages, g_post) = jax.value_and_grad(fwd)(diff_args)
+        if clip_norm is not None:
+            sq = lambda tree: sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(tree)
+            )
+            local_sq = sq(g_stages)
+            total_sq = lax.psum(local_sq, axis) + sq(g_pre) + sq(g_post)
+            norm = jnp.sqrt(total_sq)
+            scale = jnp.asarray(clip_norm, jnp.float32) / jnp.maximum(
+                norm, clip_norm
+            )
+            clip = lambda tree: jax.tree.map(
+                lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), tree
+            )
+            g_pre, g_stages, g_post = clip(g_pre), clip(g_stages), clip(g_post)
         # re-stack to the [1, ...] local slice of the stage-stacked layout
         g_stages = jax.tree.map(lambda g: g[None], g_stages)
         grads = (
