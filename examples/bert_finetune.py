@@ -158,8 +158,8 @@ def main(argv=None):
     if args.hf_checkpoint and args.num_experts:
         parser.error("--num-experts cannot combine with --hf-checkpoint "
                      "(pretrained dense FFN weights have no expert bank)")
-    if min(args.dp, args.tp, args.ep, args.sp) < 1:
-        parser.error("--dp/--tp/--ep/--sp must be >= 1")
+    if min(args.dp, args.tp, args.ep, args.sp, args.pp) < 1:
+        parser.error("--dp/--tp/--ep/--sp/--pp must be >= 1")
     if args.ep > 1 and (args.num_experts == 0 or args.num_experts % args.ep):
         parser.error("--ep requires --num-experts divisible by it")
     if args.sp > 1 and (args.tp > 1 or args.ep > 1):

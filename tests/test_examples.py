@@ -107,14 +107,22 @@ def test_bert_entrypoint_sp_mesh_smoke(tmp_path):
     assert 0.0 <= res["accuracy"] <= 1.0
 
 
-def test_bert_entrypoint_flag_validation():
+def test_bert_entrypoint_flag_validation(tmp_path):
     with pytest.raises(SystemExit):
         _run_example("bert_finetune", ["--ep", "2"])  # needs --num-experts
     with pytest.raises(SystemExit):  # expert count must divide over --ep
         _run_example("bert_finetune", ["--ep", "2", "--num-experts", "3"])
     with pytest.raises(SystemExit):
         _run_example("bert_finetune", ["--dp", "0"])
+    with pytest.raises(SystemExit):
+        _run_example("bert_finetune", ["--pp", "0"])
     with pytest.raises(SystemExit):  # sp excludes tp/ep
         _run_example("bert_finetune", ["--sp", "2", "--tp", "2"])
     with pytest.raises(SystemExit):  # seq len must split over sp
         _run_example("bert_finetune", ["--sp", "3", "--seq-len", "32"])
+    with pytest.raises(SystemExit):  # pp composes with dp only
+        _run_example("bert_finetune", ["--pp", "2", "--sp", "2"])
+    with pytest.raises(SystemExit):  # 4 encoder layers cannot split 3 ways;
+        # this errors after data prep, so confine the model-dir side effect
+        _run_example("bert_finetune", ["--pp", "3",
+                                       "--model-dir", str(tmp_path / "x")])
