@@ -139,6 +139,12 @@ def main(argv=None):
              "accumulation micro-batches doubling as pipeline micro-batches "
              "(composes with --dp; forces dropout=0, excludes --tp/--ep/--sp)",
     )
+    parser.add_argument(
+        "--zero1", action="store_true",
+        help="ZeRO-1: shard the Adam moments over the 'data' axis "
+             "(per-device optimizer memory / dp; needs --dp >= 2, composes "
+             "with --tp/--ep)",
+    )
     parser.add_argument("--full", action="store_true",
                         help="reference scale: 3 epochs over the corpus")
     parser.add_argument(
@@ -172,6 +178,10 @@ def main(argv=None):
         parser.error("--pp composes with --dp only")
     if args.pp > 1 and args.mode != "scan":
         parser.error("--pp requires --mode scan")
+    if args.zero1 and args.dp < 2:
+        parser.error("--zero1 needs --dp >= 2 (moments shard over 'data')")
+    if args.zero1 and (args.sp > 1 or args.pp > 1):
+        parser.error("--zero1 runs on the GSPMD path (no --sp/--pp)")
 
     import jax.numpy as jnp
     import numpy as np
@@ -371,6 +381,7 @@ def main(argv=None):
         sharding_rules=rules,
         eval_model=eval_bundle,
         pipeline=pipeline,
+        zero1=args.zero1,
     )
 
     # per-device micro-batch × data-parallel width (mnist 03/04 semantics:

@@ -67,6 +67,7 @@ class Estimator:
         sharding_rules=None,
         eval_model: Optional[ModelBundle] = None,
         pipeline=None,
+        zero1: bool = False,
     ):
         """``warm_start``: a params pytree used instead of ``model.init`` for
         fresh runs (tf.estimator's WarmStartSettings slot — how pretrained
@@ -94,7 +95,13 @@ class Estimator:
         partitioned into stages, the accumulation K doubles as the pipeline
         micro-batch count, ``clip_norm`` applies globally across stages,
         and evaluate/predict merge the trained stages back into the dense
-        tree (so the plain ``model``/``eval_model`` serves them)."""
+        tree (so the plain ``model``/``eval_model`` serves them).
+
+        ``zero1``: shard the optimizer moments over the mesh's ``data``
+        axis (:mod:`parallel.zero` — per-device optimizer memory drops by
+        the data width; params stay replicated/rule-sharded, with the step
+        jitted under pinned in/out shardings so XLA cannot silently
+        propagate the split into parameter storage)."""
         if mode not in ("streaming", "scan"):
             raise ValueError(f"mode must be 'streaming' or 'scan', got {mode!r}")
         if sharding_rules is not None and mesh is None:
@@ -125,6 +132,15 @@ class Estimator:
                     "pipeline composes with the 'data' axis only (no "
                     "sharding_rules / 'seq' axis)"
                 )
+        if zero1:
+            from gradaccum_tpu.parallel.mesh import DATA_AXIS
+
+            if mesh is None or dict(mesh.shape).get(DATA_AXIS, 1) < 2:
+                raise ValueError("zero1 requires a mesh with a 'data' axis")
+            if self._sp_active or pipeline is not None:
+                raise ValueError(
+                    "zero1 runs on the GSPMD path (no 'seq' axis / pipeline)"
+                )
         self.model = model
         self.optimizer = optimizer
         self.accum = accum
@@ -135,6 +151,7 @@ class Estimator:
         self.sharding_rules = sharding_rules
         self.eval_model = eval_model if eval_model is not None else model
         self.pipeline = pipeline
+        self.zero1 = zero1
         self._train_step = None
         self._eval_step = None
         self._predict_fn = None
@@ -221,10 +238,15 @@ class Estimator:
         return None
 
     def _place_state(self, state):
-        """Lay the TrainState out per ``sharding_rules`` (no-op otherwise).
-        Idempotent — re-placing an already-sharded state is cheap — so it is
-        safe on every train() entry (fresh init, checkpoint restore, or a
-        state carried across train_and_evaluate chunks)."""
+        """Lay the TrainState out per ``sharding_rules`` / ``zero1``
+        (no-op otherwise). Idempotent — re-placing an already-sharded state
+        is cheap — so it is safe on every train() entry (fresh init,
+        checkpoint restore, or a state carried across train_and_evaluate
+        chunks)."""
+        if self.zero1:
+            from gradaccum_tpu.parallel.zero import zero1_shard_state
+
+            return zero1_shard_state(state, self.mesh, self.sharding_rules)
         if self.mesh is None or self.sharding_rules is None:
             return state
         from gradaccum_tpu.parallel.sharding import shard_params
@@ -233,7 +255,7 @@ class Estimator:
 
     # -- step builders ---------------------------------------------------
 
-    def _build_train_step(self):
+    def _build_train_step(self, state=None):
         if self._train_step is not None:
             return self._train_step
         loss_fn = self._loss_fn()
@@ -259,6 +281,28 @@ class Estimator:
             step = make_dp_sp_train_step(
                 loss_fn, self.optimizer, self.accum, self.mesh,
                 needs_rng=needs_rng,
+            )
+        elif self.zero1:
+            # GSPMD path with PINNED in/out shardings: the zero1 layout must
+            # not drift (XLA would otherwise propagate the moment split into
+            # parameter storage — correct numerics, undeclared layout)
+            from gradaccum_tpu.parallel.sharding import batch_sharding, replicated
+            from gradaccum_tpu.parallel.zero import zero1_state_shardings
+
+            builder = (
+                acc.accumulate_scan if self.mode == "scan" else acc.streaming_step
+            )
+            inner = builder(loss_fn, self.optimizer, self.accum,
+                            needs_rng=needs_rng)
+            sh = zero1_state_shardings(state, self.mesh, self.sharding_rules)
+            rep = replicated(self.mesh)
+            batch_sh = batch_sharding(
+                self.mesh, leading_unsharded=1 if self.mode == "scan" else 0
+            )
+            in_sh = (sh, batch_sh) + ((rep,) if needs_rng else ())
+            step = jax.jit(
+                inner, in_shardings=in_sh, out_shardings=(sh, rep),
+                donate_argnums=0,
             )
         elif self.mesh is not None and self.sharding_rules is None:
             step = make_dp_train_step(
@@ -400,7 +444,7 @@ class Estimator:
             if restored is not None:
                 state = restored
         state = self._place_state(state)
-        step_fn = self._build_train_step()
+        step_fn = self._build_train_step(state)
 
         k = self.accum.num_micro_batches if self.mode == "scan" else 1
         log_every = max(cfg.log_step_count_steps, 1)

@@ -1,0 +1,60 @@
+"""ZeRO-1: shard the optimizer moments over the ``data`` axis.
+
+Plain data-parallel training (the reference's mirrored workers,
+/root/reference/distributedExample/04:106) keeps a full copy of the Adam
+``m``/``v`` slots on every data rank — 2× params of pure overhead per
+replica. ZeRO stage 1 shards those slots across the data axis instead:
+per-device optimizer memory drops by the data width while the training
+math is unchanged, with XLA (GSPMD) inserting the collectives around the
+cheap elementwise optimizer update.
+
+Scope is stage 1 exactly: parameters (and streaming-mode accumulators,
+which the reference checkpoints as real state, optimization.py:78) stay
+replicated/rule-sharded so the forward/backward is untouched. Composes
+with model-axis rules (``bert_tp_rules`` etc.): a moment leaf the param
+rules already shard keeps that sharding — it is already split over
+``model`` — and only rule-replicated moments pick up the ``data`` split.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gradaccum_tpu.parallel.mesh import DATA_AXIS
+from gradaccum_tpu.parallel.sharding import Rules, spec_for
+from gradaccum_tpu.utils.tree import tree_map_with_names
+
+# state fields holding optimizer slots (ScanState/StreamingState.opt_state)
+_MOMENT_PREFIX = "opt_state/"
+
+
+def zero1_state_shardings(
+    state, mesh: Mesh, param_rules: Rules | None = None, axis: str = DATA_AXIS
+):
+    """Tree of NamedShardings for a Scan/Streaming TrainState with the
+    ZeRO-1 layout: every leaf follows ``param_rules`` (default replicate),
+    except rule-replicated optimizer-moment leaves, which shard over
+    ``axis`` on their first evenly-divisible dimension (scalars and
+    indivisible leaves stay replicated)."""
+    n = dict(mesh.shape)[axis]
+
+    def spec_of(name, leaf):
+        base = spec_for(name, param_rules)
+        if not name.startswith(_MOMENT_PREFIX) or base != P():
+            return NamedSharding(mesh, base)
+        for d, size in enumerate(getattr(leaf, "shape", ())):
+            if size >= n and size % n == 0:
+                return NamedSharding(mesh, P(*([None] * d), axis))
+        return NamedSharding(mesh, P())
+
+    return tree_map_with_names(spec_of, state)
+
+
+def zero1_shard_state(
+    state, mesh: Mesh, param_rules: Rules | None = None, axis: str = DATA_AXIS
+):
+    """Place the TrainState per :func:`zero1_state_shardings`."""
+    return jax.tree.map(
+        jax.device_put, state, zero1_state_shardings(state, mesh, param_rules, axis)
+    )

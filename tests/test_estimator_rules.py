@@ -304,3 +304,68 @@ def test_estimator_pipeline_rejects_bad_combos():
         gt.Estimator(bundle, gt.ops.adamw(1e-3), accum, mesh=mesh,
                      mode="scan", pipeline=spec,
                      sharding_rules=bert_tp_rules())
+
+
+@pytest.mark.parametrize("rules", [None, "tp"], ids=["dp8", "dp4xtp2"])
+def test_estimator_zero1_parity_and_layout(rng, rules):
+    """ZeRO-1 through the Estimator: moments shard over 'data', params do
+    NOT (the pinned out_shardings stop GSPMD from propagating the split
+    into parameter storage), numerics match the unsharded run."""
+    cfg = BertConfig.tiny_for_tests()
+    train = _data(rng, cfg)
+    evald = _data(rng, cfg, n=N_EVAL)
+
+    ref = _estimator(cfg)
+    ref_state = ref.train(_train_fn(train), max_steps=MAX_STEPS)
+    ref_eval = ref.evaluate(_eval_fn(evald), state=ref_state)
+
+    if rules == "tp":
+        mesh = make_mesh(data=4, model=2, devices=jax.devices())
+        sharding_rules = bert_tp_rules()
+    else:
+        mesh = make_mesh(data=8, devices=jax.devices())
+        sharding_rules = None
+    est = gt.Estimator(
+        bert_classifier_bundle(cfg, num_classes=2),
+        gt.ops.adamw(
+            gt.warmup_polynomial_decay(1e-3, num_train_steps=100, num_warmup_steps=10),
+            weight_decay_rate=0.01,
+        ),
+        gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0),
+        gt.RunConfig(seed=7),
+        mesh=mesh, mode="scan", sharding_rules=sharding_rules, zero1=True,
+    )
+    state = est.train(_train_fn(train), max_steps=MAX_STEPS)
+
+    _assert_params_close(state.params, ref_state.params)
+    res = est.evaluate(_eval_fn(evald), state=state)
+    np.testing.assert_allclose(res["accuracy"], ref_eval["accuracy"], rtol=1e-6)
+
+    from jax.sharding import PartitionSpec as P
+
+    data_split = [
+        l for l in jax.tree.leaves(state.opt_state)
+        if hasattr(l, "sharding") and "data" in str(l.sharding.spec)
+    ]
+    assert data_split, "zero1 left every moment replicated over data"
+    if sharding_rules is None:
+        # stage 1: parameter storage must stay replicated
+        assert all(
+            l.sharding.is_fully_replicated for l in jax.tree.leaves(state.params)
+        ), "zero1 leaked the moment split into param storage"
+    else:
+        # tp rules still shard params over 'model', never 'data'
+        assert not any(
+            "data" in str(l.sharding.spec) for l in jax.tree.leaves(state.params)
+        )
+
+
+def test_zero1_requires_data_mesh():
+    cfg = BertConfig.tiny_for_tests()
+    with pytest.raises(ValueError, match="data"):
+        gt.Estimator(
+            bert_classifier_bundle(cfg, num_classes=2),
+            gt.ops.adamw(1e-3),
+            gt.GradAccumConfig(num_micro_batches=K),
+            zero1=True,
+        )
