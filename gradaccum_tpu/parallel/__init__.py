@@ -7,10 +7,18 @@ from gradaccum_tpu.parallel import (
     sp,
     tp,
     ulysses,
+    zero,
 )
 from gradaccum_tpu.parallel.cross_shard import cross_shard_optimizer
 from gradaccum_tpu.parallel.dp import make_dp_train_step, make_pjit_dp_train_step
-from gradaccum_tpu.parallel.pp import make_pp_train_step, pp_init, stack_stage_params
+from gradaccum_tpu.parallel.pp import (
+    PipelineParams,
+    PipelineSpec,
+    make_pp_train_step,
+    pp_init,
+    stack_stage_params,
+)
+from gradaccum_tpu.parallel.zero import zero1_shard_state, zero1_state_shardings
 from gradaccum_tpu.parallel.mesh import (
     DATA_AXIS,
     EXPERT_AXIS,
@@ -35,5 +43,5 @@ from gradaccum_tpu.parallel.sharding import (
     shard_params,
 )
 from gradaccum_tpu.parallel.sp import make_dp_sp_train_step
-from gradaccum_tpu.parallel.tp import bert_tp_rules
+from gradaccum_tpu.parallel.tp import bert_tp_ep_rules, bert_tp_rules
 from gradaccum_tpu.parallel.ulysses import make_ulysses_attention_fn, ulysses_attention
