@@ -40,6 +40,7 @@ def bert_train_flops_per_seq(
     intermediate: int,
     seq: int,
     num_classes: int,
+    num_experts: int = 0,
 ) -> float:
     """Analytic fwd+bwd matmul FLOPs for one sequence of BERT fine-tuning.
 
@@ -48,9 +49,14 @@ def bert_train_flops_per_seq(
     sequence. Backward ~= 2x forward (grads w.r.t. both inputs and
     weights), so train = 3x fwd. Embedding gather/scatter-add contribute
     ~0 matmul FLOPs.
+
+    ``num_experts``: top-1-routed MoE FFN — each token still runs ONE
+    expert of the same ``intermediate`` size (so the FFN term is
+    unchanged), plus the router matmul ``2*H*E`` per token per layer.
     """
-    per_tok = layers * (
-        8 * hidden * hidden + 4 * hidden * intermediate + 4 * seq * hidden
-    )
+    ffn = 4 * hidden * intermediate
+    if num_experts > 0:
+        ffn += 2 * hidden * num_experts  # router logits
+    per_tok = layers * (8 * hidden * hidden + ffn + 4 * seq * hidden)
     fwd = seq * per_tok + 2 * hidden * hidden + 2 * hidden * num_classes
     return 3.0 * fwd
