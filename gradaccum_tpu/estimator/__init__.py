@@ -1,7 +1,8 @@
-from gradaccum_tpu.estimator import checkpoint, config, estimator, metrics
+from gradaccum_tpu.estimator import checkpoint, config, estimator, export, metrics
 from gradaccum_tpu.estimator.checkpoint import latest_checkpoint, restore, save
 from gradaccum_tpu.estimator.config import EvalSpec, RunConfig, TrainSpec
 from gradaccum_tpu.estimator.estimator import Estimator, ModelBundle
+from gradaccum_tpu.estimator.export import export_predict, load_exported
 from gradaccum_tpu.estimator.metrics import (
     accuracy,
     add_metrics,

@@ -598,6 +598,28 @@ class Estimator:
                 yield jax.tree.map(lambda x: x[i], outputs)
             batch = next(it, None)
 
+    def export_model(
+        self,
+        export_dir: str,
+        sample_batch,
+        state=None,
+        checkpoint_path: Optional[str] = None,
+        batch_polymorphic: bool = True,
+    ) -> str:
+        """Serialize the predict function + trained weights to one portable
+        StableHLO artifact (tf.estimator's ``export_savedmodel`` slot).
+        Uses the same newest-checkpoint resolution as evaluate/predict;
+        pipeline-trained stages are merged to the dense tree first. Load it
+        back — without the model code — via
+        :func:`gradaccum_tpu.estimator.export.load_exported`."""
+        from gradaccum_tpu.estimator.export import export_predict
+
+        params, _ = self._params_for_inference(sample_batch, state, checkpoint_path)
+        return export_predict(
+            self.eval_model.predict, params, sample_batch, export_dir,
+            batch_polymorphic=batch_polymorphic,
+        )
+
     def train_and_evaluate(self, train_spec: TrainSpec, eval_spec: EvalSpec):
         """``tf.estimator.train_and_evaluate`` parity: train in chunks,
         evaluating at most every ``throttle_secs`` (another-example.py:318),
