@@ -571,3 +571,40 @@ def test_export_model_roundtrip(rng, tmp_path):
         np.asarray(est.model.predict(state.params, sample)["predictions"]),
         rtol=1e-6,
     )
+
+
+def test_export_model_bert(rng, tmp_path):
+    """The flagship model exports and reloads: embeddings/LayerNorm/attention
+    survive the StableHLO roundtrip bit-for-bit at an unseen batch size."""
+    from gradaccum_tpu.estimator.export import load_exported
+    from gradaccum_tpu.models.bert import BertConfig, bert_classifier_bundle
+
+    cfg = BertConfig.tiny_for_tests()
+    bundle = bert_classifier_bundle(cfg, num_classes=2)
+    S = 16
+    np_rng = np.random.default_rng(0)
+
+    def batch(n):
+        return {
+            "input_ids": np_rng.integers(0, cfg.vocab_size, size=(n, S)).astype(np.int32),
+            "input_mask": np.ones((n, S), np.int32),
+            "segment_ids": np.zeros((n, S), np.int32),
+        }
+
+    params = bundle.init(jax.random.PRNGKey(0), dict(batch(4), label=np.zeros(4, np.int32)))
+    est = Estimator(
+        bundle, adam(1e-3), GradAccumConfig(num_micro_batches=K),
+        RunConfig(), mode="scan", warm_start=params,
+    )
+    d = str(tmp_path / "bert_export")
+    est.export_model(d, batch(4))
+
+    other = batch(6)
+    got = load_exported(d)(other)
+    want = bundle.predict(params, other)
+    np.testing.assert_allclose(
+        np.asarray(got["logits"]), np.asarray(want["logits"]), rtol=1e-6
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got["classes"]), np.asarray(want["classes"])
+    )
