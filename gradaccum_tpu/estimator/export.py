@@ -43,6 +43,10 @@ def export_predict(
     if not isinstance(sample_batch, dict):
         raise TypeError("export expects dict batches (the ModelBundle contract)")
 
+    # gather mesh-sharded params (tp/ep/zero1 training) to host so the
+    # exported module is single-device and self-contained
+    params = jax.device_get(params)
+
     def serve(batch):
         return predict_fn(params, batch)
 

@@ -407,3 +407,25 @@ def test_estimator_rules_streaming_mode_parity(rng):
         if hasattr(l, "sharding") and not l.sharding.is_fully_replicated
     ]
     assert accum_sharded, "rules did not shard the streaming accumulators"
+
+
+def test_export_from_rules_sharded_training(rng, tmp_path):
+    """A tp-rules-trained Estimator exports a single-device artifact: the
+    mesh-sharded params gather to host before being baked in."""
+    from gradaccum_tpu.estimator.export import load_exported
+
+    cfg = BertConfig.tiny_for_tests()
+    train = _data(rng, cfg)
+    mesh = make_mesh(data=4, model=2, devices=jax.devices())
+    est = _estimator(cfg, mesh=mesh, rules=bert_tp_rules())
+    state = est.train(_train_fn(train), max_steps=2 * K)
+
+    sample = {k: v[:4] for k, v in _data(rng, cfg, n=8).items() if k != "label"}
+    d = str(tmp_path / "exp")
+    est.export_model(d, sample, state=state)
+    got = load_exported(d)(sample)
+    want = est.eval_model.predict(jax.device_get(state.params), sample)
+    np.testing.assert_allclose(
+        np.asarray(got["logits"]), np.asarray(want["logits"]),
+        rtol=1e-5, atol=1e-6,
+    )
