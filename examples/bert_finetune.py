@@ -145,6 +145,11 @@ def main(argv=None):
              "(per-device optimizer memory / dp; needs --dp >= 2, composes "
              "with --tp/--ep)",
     )
+    parser.add_argument(
+        "--export-dir", default=None,
+        help="after training, serialize predict + weights to this dir as a "
+             "StableHLO serving artifact (estimator/export.py)",
+    )
     parser.add_argument("--full", action="store_true",
                         help="reference scale: 3 epochs over the corpus")
     parser.add_argument(
@@ -406,6 +411,10 @@ def main(argv=None):
     )
     print(f"{args.task}: eval accuracy {results['accuracy']:.4f} "
           f"(effective batch {micro * k}, loss CSV in {model_dir})")
+    if args.export_dir:
+        sample = {key: v[:1] for key, v in evald.items() if key != "label"}
+        blob = est.export_model(args.export_dir, sample, state=state)
+        print(f"exported serving artifact: {blob}")
     return results
 
 
