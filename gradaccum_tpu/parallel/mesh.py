@@ -124,11 +124,33 @@ def make_hybrid_mesh(
         )
     from jax.experimental import mesh_utils
 
-    dev_array = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=[1] * len(dcn_axes) + [s for _, s in ici_axes],
-        dcn_mesh_shape=dcn_sizes + [1] * len(ici_axes),
-        devices=devices,
-    )
+    ici_sizes = [s for _, s in ici_axes]
+    try:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=[1] * len(dcn_axes) + ici_sizes,
+            dcn_mesh_shape=dcn_sizes + [1] * len(ici_axes),
+            devices=devices,
+        )
+    except ValueError:
+        # No slice topology (e.g. a CPU jax.distributed cluster, where every
+        # device reports the same slice): treat each PROCESS as a slice —
+        # DCN axes split across processes, ICI axes within one process's
+        # devices. This is the 2-worker TF_CONFIG shape of the reference
+        # (distributedExample/03:68-74) mapped onto the hybrid layout.
+        procs = sorted({d.process_index for d in devices})
+        if len(procs) != int(np.prod(dcn_sizes)):
+            raise ValueError(
+                f"hybrid mesh fallback: {len(procs)} processes cannot form "
+                f"dcn axes {dcn_axes}"
+            )
+        by_proc = sorted(devices, key=lambda d: (d.process_index, d.id))
+        per = len(devices) // len(procs)
+        if per != int(np.prod(ici_sizes)):
+            raise ValueError(
+                f"hybrid mesh fallback: {per} devices per process cannot "
+                f"form ici axes {ici_axes}"
+            )
+        dev_array = np.array(by_proc).reshape(tuple(dcn_sizes) + tuple(ici_sizes))
     return Mesh(dev_array, names)
 
 

@@ -75,3 +75,45 @@ def test_two_process_dp_step():
     assert fields0["devices"] == fields1["devices"] == "4"
     assert fields0["loss"] == fields1["loss"]
     assert fields0["w00"] == fields1["w00"]
+
+
+def test_two_process_hybrid_mesh_model_sharding():
+    """make_hybrid_mesh across real processes: 'data' (DCN) spans the two
+    workers, 'model' (ICI) stays on each worker's local devices, and the
+    GSPMD step tensor-shards the hidden layer — both processes must compute
+    the single-process reference update exactly."""
+    port = _free_port()
+    env = _worker_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(i), "2", str(port), "hybrid"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=_TIMEOUT_S)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip(f"hybrid multihost test timed out after {_TIMEOUT_S}s")
+
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert "MULTIHOST_HYBRID_OK" in out, f"worker {i} missing OK line:\n{out}"
+
+    def ok_line(out):
+        return [l for l in out.splitlines()
+                if l.startswith("MULTIHOST_HYBRID_OK")][0]
+
+    fields0 = dict(kv.split("=") for kv in ok_line(outs[0]).split()[1:])
+    fields1 = dict(kv.split("=") for kv in ok_line(outs[1]).split()[1:])
+    assert fields0["mesh"] == fields1["mesh"] == "data2xmodel2"
+    assert fields0["loss"] == fields1["loss"]
+    assert fields0["w100"] == fields1["w100"]
