@@ -106,11 +106,10 @@ class Estimator:
             raise ValueError(f"mode must be 'streaming' or 'scan', got {mode!r}")
         if sharding_rules is not None and mesh is None:
             raise ValueError("sharding_rules requires a mesh")
-        from gradaccum_tpu.parallel.mesh import SEQ_AXIS
+        from gradaccum_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS, SEQ_AXIS
 
-        self._sp_active = (
-            mesh is not None and dict(mesh.shape).get(SEQ_AXIS, 1) > 1
-        )
+        axes = dict(mesh.shape) if mesh is not None else {}
+        self._sp_active = axes.get(SEQ_AXIS, 1) > 1
         if self._sp_active:
             if mode != "scan":
                 raise ValueError("a 'seq' mesh axis requires mode='scan'")
@@ -120,9 +119,7 @@ class Estimator:
                     "(sequence parallelism runs on the shard_map path)"
                 )
         if pipeline is not None:
-            from gradaccum_tpu.parallel.mesh import PIPE_AXIS
-
-            if mesh is None or dict(mesh.shape).get(PIPE_AXIS, 1) < 2:
+            if axes.get(PIPE_AXIS, 1) < 2:
                 raise ValueError("pipeline requires a mesh with a 'pipe' axis")
             if mode != "scan":
                 raise ValueError("pipeline requires mode='scan' (K pipeline "
@@ -133,9 +130,7 @@ class Estimator:
                     "sharding_rules / 'seq' axis)"
                 )
         if zero1:
-            from gradaccum_tpu.parallel.mesh import DATA_AXIS
-
-            if mesh is None or dict(mesh.shape).get(DATA_AXIS, 1) < 2:
+            if axes.get(DATA_AXIS, 1) < 2:
                 raise ValueError("zero1 requires a mesh with a 'data' axis")
             if self._sp_active or pipeline is not None:
                 raise ValueError(
@@ -282,46 +277,43 @@ class Estimator:
                 loss_fn, self.optimizer, self.accum, self.mesh,
                 needs_rng=needs_rng,
             )
-        elif self.zero1:
-            # GSPMD path with PINNED in/out shardings: the zero1 layout must
-            # not drift (XLA would otherwise propagate the moment split into
-            # parameter storage — correct numerics, undeclared layout)
-            from gradaccum_tpu.parallel.sharding import batch_sharding, replicated
-            from gradaccum_tpu.parallel.zero import zero1_state_shardings
-
-            builder = (
-                acc.accumulate_scan if self.mode == "scan" else acc.streaming_step
-            )
-            inner = builder(loss_fn, self.optimizer, self.accum,
-                            needs_rng=needs_rng)
-            sh = zero1_state_shardings(state, self.mesh, self.sharding_rules)
-            rep = replicated(self.mesh)
-            batch_sh = batch_sharding(
-                self.mesh, leading_unsharded=1 if self.mode == "scan" else 0
-            )
-            in_sh = (sh, batch_sh) + ((rep,) if needs_rng else ())
-            step = jax.jit(
-                inner, in_shardings=in_sh, out_shardings=(sh, rep),
-                donate_argnums=0,
-            )
-        elif self.mesh is not None and self.sharding_rules is None:
+        elif self.mesh is not None and self.sharding_rules is None and not self.zero1:
             step = make_dp_train_step(
                 loss_fn, self.optimizer, self.accum, self.mesh,
                 mode=self.mode, needs_rng=needs_rng,
             )
         else:
-            # Single jit covers both the no-mesh case and the GSPMD path:
-            # with sharding_rules the state is pre-placed by the rules
+            # Single jit covers the no-mesh case and the GSPMD paths: with
+            # sharding_rules the state is pre-placed by the rules
             # (:meth:`_place_state`) and the batch by ``device_put_batch``;
             # jit propagates operand shardings and XLA inserts the
             # collectives, so tp/ep axes compose with ``data`` for free.
+            # zero1 additionally PINS in/out shardings — without them XLA
+            # would propagate the moment split into parameter storage
+            # (correct numerics, undeclared layout).
             builder = (
                 acc.accumulate_scan if self.mode == "scan" else acc.streaming_step
             )
-            step = jax.jit(
-                builder(loss_fn, self.optimizer, self.accum, needs_rng=needs_rng),
-                donate_argnums=0,
-            )
+            inner = builder(loss_fn, self.optimizer, self.accum,
+                            needs_rng=needs_rng)
+            jit_kwargs = {}
+            if self.zero1:
+                from gradaccum_tpu.parallel.sharding import (
+                    batch_sharding,
+                    replicated,
+                )
+                from gradaccum_tpu.parallel.zero import zero1_state_shardings
+
+                sh = zero1_state_shardings(state, self.mesh, self.sharding_rules)
+                rep = replicated(self.mesh)
+                batch_sh = batch_sharding(
+                    self.mesh, leading_unsharded=1 if self.mode == "scan" else 0
+                )
+                jit_kwargs = dict(
+                    in_shardings=(sh, batch_sh) + ((rep,) if needs_rng else ()),
+                    out_shardings=(sh, rep),
+                )
+            step = jax.jit(inner, donate_argnums=0, **jit_kwargs)
         self._train_step = step
         return step
 
@@ -696,10 +688,9 @@ class Estimator:
                 else [jax.devices()[0]]
             )
             per_chip = peak_flops_for(devices[0].device_kind)
-            self._peak_flops = (
-                per_chip * len(devices) if per_chip else float("nan")
-            )
-        if self._peak_flops != self._peak_flops:  # unknown device kind
+            # 0.0 = unknown device kind (e.g. CPU tests): omit MFU
+            self._peak_flops = per_chip * len(devices) if per_chip else 0.0
+        if not self._peak_flops:
             return None
         return examples_per_sec * self.config.flops_per_example / self._peak_flops
 

@@ -87,7 +87,7 @@ def main(process_id: int, num_processes: int, port: int) -> None:
     )
 
 
-def _local_full(arr, np):
+def _local_full(arr):
     """Materialize a global array from this process's addressable shards.
     Valid when every index region has a local shard (e.g. sharded over an
     in-process 'model' axis, replicated over the cross-process 'data'
@@ -171,12 +171,12 @@ def main_hybrid(process_id: int, num_processes: int, port: int) -> None:
     state = shard_params(scan_init(params, opt), mesh, rules)
     state, aux = step(state, batch)
 
-    got = {k: _local_full(v, np) for k, v in state.params.items()}
+    got = {k: _local_full(v) for k, v in state.params.items()}
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
         got, ref_params,
     )
-    loss = float(_local_full(aux["loss"], np))
+    loss = float(_local_full(aux["loss"]))
     np.testing.assert_allclose(loss, ref_loss, rtol=1e-6)
     # the hidden layer really was model-sharded on this process's devices
     w1_specs = {tuple(s.index[1].indices(H)) for s in state.params["w1"].addressable_shards}
