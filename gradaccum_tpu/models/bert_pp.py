@@ -70,8 +70,13 @@ class BertStage(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask):
+        layer_cls = EncoderLayer
+        if self.config.remat:
+            # same knob as BertEncoder: recompute stage activations in the
+            # backward schedule instead of holding K micro-batches' worth
+            layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
         for j in range(self.layers_per_stage):
-            x = EncoderLayer(self.config, name=f"sub_{j}")(x, mask, True)
+            x = layer_cls(self.config, name=f"sub_{j}")(x, mask, True)
         return x
 
 

@@ -336,3 +336,54 @@ def test_bert_pp_rejects_dropout_and_moe(rng):
             ),
             layers_per_stage=1,
         )
+
+
+def test_bert_pipeline_remat_matches(rng):
+    """cfg.remat in the pipeline stages recomputes activations without
+    changing the update."""
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.models.bert import BertConfig, bert_classifier_bundle
+    from gradaccum_tpu.models.bert_pp import bert_pp_fns, bert_pp_partition
+
+    K, micro, S = 2, 4, 16
+    np_rng = np.random.default_rng(5)
+    opt = adamw(1e-3, weight_decay_rate=0.01)
+    mesh = make_mesh(pipe=2, devices=jax.devices()[:2])
+
+    cfg0 = BertConfig.tiny_for_tests(hidden_dropout=0.0, attention_dropout=0.0)
+    bundle = bert_classifier_bundle(cfg0, num_classes=2)
+    batch = {
+        "input_ids": np_rng.integers(0, cfg0.vocab_size, size=(K * micro, S)).astype(np.int32),
+        "input_mask": np.ones((K * micro, S), np.int32),
+        "segment_ids": np.zeros((K * micro, S), np.int32),
+        "label": np_rng.integers(0, 2, size=(K * micro,)).astype(np.int32),
+    }
+    # host copy: the donating pp step must not invalidate the shared source
+    dense_params = jax.device_get(bundle.init(jax.random.PRNGKey(0), batch))
+    stacked = gt.stack_micro_batches(batch, K)
+
+    outs = {}
+    for remat in (False, True):
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg0, remat=remat)
+        pre_fn, stage_fn, loss_fn_b = bert_pp_fns(cfg, layers_per_stage=1)
+        pre, stages, post = bert_pp_partition(dense_params, 2)
+        step = make_pp_train_step(
+            stage_fn, loss_fn_b, opt, K, mesh,
+            input_key="input_ids", pre_fn=pre_fn, ctx_keys=("input_mask",),
+        )
+        state, aux = step(
+            pp_init(stages, opt, pre_params=pre, post_params=post), stacked
+        )
+        outs[remat] = (float(jax.device_get(aux["loss"])),
+                       jax.device_get(state.params))
+
+    # remat recomputes through different fusions: equal up to rounding
+    np.testing.assert_allclose(outs[False][0], outs[True][0], rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        ),
+        outs[False][1], outs[True][1],
+    )
