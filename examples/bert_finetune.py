@@ -109,6 +109,11 @@ def main(argv=None):
              "(expert parallelism via models/moe.py; 0 = dense)",
     )
     parser.add_argument(
+        "--moe-top-k", type=int, default=1,
+        help="experts per token: 1 = Switch routing, 2 = GShard top-2 "
+             "(renormalized gates, rank-ordered capacity)",
+    )
+    parser.add_argument(
         "--dp", type=int, default=1,
         help="data-parallel mesh width (the reference's worker count, 03:76)",
     )
@@ -258,6 +263,7 @@ def main(argv=None):
             vocab_size=max(len(tok.vocab), 128),
             dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
             num_experts=args.num_experts,
+            moe_top_k=args.moe_top_k,
         )
     import dataclasses
 

@@ -55,6 +55,7 @@ class BertConfig:
     # and the classifier loss adds moe_aux_weight * load-balance loss.
     num_experts: int = 0
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1  # 1 = Switch routing; 2 = GShard-style top-2
     moe_aux_weight: float = 0.01
 
     @staticmethod
@@ -143,7 +144,7 @@ class MoEFFN(nn.Module):
             "b_out": self.param("b_out", nn.initializers.zeros, (e, d)),
         }
         params = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
-        y, aux = moe_apply(params, x, cfg.moe_capacity_factor)
+        y, aux = moe_apply(params, x, cfg.moe_capacity_factor, cfg.moe_top_k)
         self.sow("losses", "load_balance", aux["load_balance_loss"])
         return y
 

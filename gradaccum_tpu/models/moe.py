@@ -56,8 +56,14 @@ def moe_apply(
     params: Dict[str, Any],
     x: jnp.ndarray,
     capacity_factor: float = 1.25,
+    top_k: int = 1,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Apply the MoE FFN to ``x: [..., T, D]`` (leading dims folded into T).
+
+    ``top_k=1`` is Switch routing (raw softmax gate weight); ``top_k>1`` is
+    GShard-style: each token dispatches to its top-k experts with the
+    selected gates renormalized to sum 1, choice ranks claiming expert
+    capacity in order (rank-0 assignments fill slots before rank-1).
 
     Returns ``(y, aux)`` with ``y`` zero for dropped tokens (add the
     residual outside) and ``aux = {"load_balance_loss", "dropped_fraction",
@@ -68,37 +74,54 @@ def moe_apply(
     x2 = x.reshape(-1, d)  # [T, D]
     t = x2.shape[0]
     e = params["router"].shape[-1]
+    if not 1 <= top_k <= e:
+        raise ValueError(f"top_k={top_k} must be in [1, num_experts={e}]")
     capacity = int(np.ceil(t / e * capacity_factor))
 
     logits = (x2 @ params["router"]).astype(jnp.float32)  # [T, E]
     gates = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(gates, axis=-1)  # [T]
-    gate = jnp.max(gates, axis=-1)  # [T]
-
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T, E]
-    # position of each token within its expert's queue (0-based)
-    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # [T, E], -1 elsewhere
-    keep = (position >= 0) & (position < capacity)  # [T, E]; ≤1 true per row
-    # each kept token's slot index; keep masks out dropped tokens entirely
-    pos = (position * keep).sum(axis=-1).astype(jnp.int32)  # [T]
-    dispatch = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [T, C]
-    dispatch = dispatch[:, None, :] * keep.astype(jnp.float32)[:, :, None]  # [T,E,C]
+    top_gates, top_idx = jax.lax.top_k(gates, top_k)  # [T, k]
+    if top_k == 1:
+        weights = top_gates  # Switch: raw probability
+    else:
+        weights = top_gates / jnp.sum(top_gates, axis=-1, keepdims=True)
 
     compute_dtype = x2.dtype
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)  # [T, E, C]
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    prior = jnp.zeros((e,), jnp.float32)  # slots claimed by earlier ranks
+    kept_assignments = 0.0
+    for r in range(top_k):  # static, tiny loop (k is 1 or 2 in practice)
+        onehot = jax.nn.one_hot(top_idx[:, r], e, dtype=jnp.float32)  # [T, E]
+        # position of each token within its expert's queue, after the slots
+        # earlier choice ranks already claimed
+        position = (jnp.cumsum(onehot, axis=0) + prior[None, :]) * onehot - 1.0
+        keep = (position >= 0) & (position < capacity)  # [T, E]; ≤1 true/row
+        pos = (position * keep).sum(axis=-1).astype(jnp.int32)  # [T]
+        disp_r = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [T, C]
+        disp_r = disp_r[:, None, :] * keep.astype(jnp.float32)[:, :, None]
+        dispatch = dispatch + disp_r
+        combine = combine + disp_r * weights[:, r, None, None]
+        prior = prior + jnp.sum(onehot, axis=0)
+        kept_assignments = kept_assignments + jnp.sum(disp_r)
+
     dispatch_c = dispatch.astype(compute_dtype)
     expert_in = jnp.einsum("tec,td->ecd", dispatch_c, x2)  # [E, C, D]
     h = jnp.einsum("ecd,edh->ech", expert_in, params["w_in"])
     h = jax.nn.gelu(h + params["b_in"][:, None, :], approximate=False)
     out = jnp.einsum("ech,ehd->ecd", h, params["w_out"])
     out = out + params["b_out"][:, None, :]
-    combine = dispatch_c * gate.astype(compute_dtype)[:, None, None]
-    y = jnp.einsum("tec,ecd->td", combine, out)  # [T, D]; zeros for dropped
+    y = jnp.einsum(
+        "tec,ecd->td", combine.astype(compute_dtype), out
+    )  # [T, D]; zeros for dropped
 
-    # Switch load-balancing loss: E · Σ_e (token fraction)·(mean gate)
-    token_frac = jnp.mean(onehot, axis=0)
+    # Switch/GShard load-balancing loss: E · Σ_e (top-1 token fraction)·(mean gate)
+    token_frac = jnp.mean(
+        jax.nn.one_hot(top_idx[:, 0], e, dtype=jnp.float32), axis=0
+    )
     gate_mean = jnp.mean(gates, axis=0)
     load_balance = e * jnp.sum(token_frac * gate_mean)
-    dropped = 1.0 - jnp.sum(dispatch) / t
+    dropped = 1.0 - kept_assignments / (t * top_k)
     entropy = -jnp.mean(jnp.sum(gates * jnp.log(gates + 1e-9), axis=-1))
 
     aux = {
