@@ -385,7 +385,8 @@ def main(argv=None):
         gt.RunConfig(model_dir=model_dir, log_step_count_steps=max(max_steps // 20, 1),
                      flops_per_example=bert_train_flops_per_seq(
                          cfg.hidden_size, cfg.num_layers, cfg.intermediate_size,
-                         args.seq_len, 2, num_experts=cfg.num_experts)),
+                         args.seq_len, 2, num_experts=cfg.num_experts,
+                         moe_top_k=cfg.moe_top_k)),
         mode=args.mode,
         warm_start=pretrained,
         mesh=mesh,

@@ -76,7 +76,10 @@ def moe_apply(
     e = params["router"].shape[-1]
     if not 1 <= top_k <= e:
         raise ValueError(f"top_k={top_k} must be in [1, num_experts={e}]")
-    capacity = int(np.ceil(t / e * capacity_factor))
+    # GShard capacity scaling: top_k·t total assignments spread over e
+    # experts — without the top_k factor, balanced top-2 routing would drop
+    # second choices even at capacity_factor >= 1
+    capacity = int(np.ceil(top_k * t / e * capacity_factor))
 
     logits = (x2 @ params["router"]).astype(jnp.float32)  # [T, E]
     gates = jax.nn.softmax(logits, axis=-1)

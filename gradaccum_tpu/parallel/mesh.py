@@ -137,14 +137,23 @@ def make_hybrid_mesh(
         # DCN axes split across processes, ICI axes within one process's
         # devices. This is the 2-worker TF_CONFIG shape of the reference
         # (distributedExample/03:68-74) mapped onto the hybrid layout.
-        procs = sorted({d.process_index for d in devices})
-        if len(procs) != int(np.prod(dcn_sizes)):
+        from collections import Counter
+
+        counts = Counter(d.process_index for d in devices)
+        if len(counts) != int(np.prod(dcn_sizes)):
             raise ValueError(
-                f"hybrid mesh fallback: {len(procs)} processes cannot form "
+                f"hybrid mesh fallback: {len(counts)} processes cannot form "
                 f"dcn axes {dcn_axes}"
             )
+        if len(set(counts.values())) != 1:
+            # uneven ownership would let the reshape silently place devices
+            # of different processes in the same "ICI" block
+            raise ValueError(
+                f"hybrid mesh fallback needs uniform devices per process, "
+                f"got {dict(counts)}"
+            )
         by_proc = sorted(devices, key=lambda d: (d.process_index, d.id))
-        per = len(devices) // len(procs)
+        per = next(iter(counts.values()))
         if per != int(np.prod(ici_sizes)):
             raise ValueError(
                 f"hybrid mesh fallback: {per} devices per process cannot "

@@ -41,6 +41,7 @@ def bert_train_flops_per_seq(
     seq: int,
     num_classes: int,
     num_experts: int = 0,
+    moe_top_k: int = 1,
 ) -> float:
     """Analytic fwd+bwd matmul FLOPs for one sequence of BERT fine-tuning.
 
@@ -50,13 +51,13 @@ def bert_train_flops_per_seq(
     weights), so train = 3x fwd. Embedding gather/scatter-add contribute
     ~0 matmul FLOPs.
 
-    ``num_experts``: top-1-routed MoE FFN — each token still runs ONE
-    expert of the same ``intermediate`` size (so the FFN term is
-    unchanged), plus the router matmul ``2*H*E`` per token per layer.
+    ``num_experts``: MoE FFN — each token runs ``moe_top_k`` experts of the
+    same ``intermediate`` size (so the FFN term scales by ``moe_top_k``),
+    plus the router matmul ``2*H*E`` per token per layer.
     """
     ffn = 4 * hidden * intermediate
     if num_experts > 0:
-        ffn += 2 * hidden * num_experts  # router logits
+        ffn = ffn * moe_top_k + 2 * hidden * num_experts  # k experts + router
     per_tok = layers * (8 * hidden * hidden + ffn + 4 * seq * hidden)
     fwd = seq * per_tok + 2 * hidden * hidden + 2 * hidden * num_classes
     return 3.0 * fwd

@@ -278,3 +278,17 @@ def test_top2_rejects_bad_k(rng):
         moe_apply(params, x, top_k=3)
     with pytest.raises(ValueError, match="top_k"):
         moe_apply(params, x, top_k=0)
+
+
+def test_top2_capacity_scales_with_k(rng):
+    """Default capacity_factor must not guarantee second-choice drops: with
+    top_k=2 the slot budget scales by k (GShard), so near-balanced routing
+    keeps most assignments."""
+    import jax.numpy as jnp
+
+    D, H, E, T = 8, 16, 4, 64
+    params = moe_init(jax.random.PRNGKey(3), D, H, E)
+    x = jnp.asarray(rng.normal(size=(T, D)), jnp.float32)
+    _, aux = moe_apply(params, x, capacity_factor=1.25, top_k=2)
+    # pre-fix this was >= 0.375 by construction (2t assignments, 1.25t slots)
+    assert float(aux["dropped_fraction"]) < 0.375
