@@ -184,10 +184,19 @@ def main(argv=None):
             entry["quick"] = True
         summary["runs"][name] = entry
 
+    def reload(name, curves):
+        """Reload a previously measured curve for an --only rerun of another
+        group; a missing file (fresh --out dir) skips the overlay entry
+        instead of failing after the requested group already ran."""
+        path = out / f"{name}.csv"
+        if not path.exists():
+            print(f"[results] no prior curve for {name} ({path}); skipping")
+            return
+        record(name, curves, *read_curve_file(path), reloaded=True)
+
     for name, extra in MNIST_RUNS:
         if args.only not in ("all", "mnist"):
-            record(name, mnist_curves, *read_curve_file(out / f"{name}.csv"),
-                   reloaded=True)
+            reload(name, mnist_curves)
             continue
         model_dir, acc = run_one("mnist.py", name, extra, run_root,
                                  args.quick, run_timeout=args.run_timeout)
@@ -197,8 +206,7 @@ def main(argv=None):
 
     for name, extra in BERT_RUNS:
         if args.only not in ("all", "bert"):
-            record(name, bert_curves, *read_curve_file(out / f"{name}.csv"),
-                   reloaded=True)
+            reload(name, bert_curves)
             continue
         model_dir, acc = run_one("bert_finetune.py", name, extra, run_root,
                                  args.quick, cpu_mesh=False,
