@@ -429,3 +429,40 @@ def test_export_from_rules_sharded_training(rng, tmp_path):
         np.asarray(got["logits"]), np.asarray(want["logits"]),
         rtol=1e-5, atol=1e-6,
     )
+
+
+def test_estimator_zero1_streaming_mode(rng):
+    """zero1 composes with the reference's exact streaming semantics: the
+    accumulators stay replicated (stage-1 scope), moments shard over data."""
+    cfg = BertConfig.tiny_for_tests()
+    train = _data(rng, cfg)
+
+    def stream_fn():
+        return gt.Dataset.from_arrays(train).repeat().batch(
+            MICRO, drop_remainder=True
+        )
+
+    def estimator(**kw):
+        return gt.Estimator(
+            bert_classifier_bundle(cfg, num_classes=2),
+            gt.ops.adamw(1e-3, weight_decay_rate=0.01),
+            gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0),
+            gt.RunConfig(seed=7),
+            mode="streaming", **kw,
+        )
+
+    ref_state = estimator().train(stream_fn, max_steps=3 * K)
+
+    mesh = make_mesh(data=8, devices=jax.devices())
+    state = estimator(mesh=mesh, zero1=True).train(stream_fn, max_steps=3 * K)
+
+    _assert_params_close(state.params, ref_state.params)
+    assert any(
+        "data" in str(l.sharding.spec) for l in jax.tree.leaves(state.opt_state)
+        if hasattr(l, "sharding")
+    )
+    # stage-1 scope: accumulators and params stay replicated
+    for tree in (state.params, state.accum_grads):
+        assert all(
+            l.sharding.is_fully_replicated for l in jax.tree.leaves(tree)
+        )
