@@ -21,6 +21,11 @@ def main(argv=None):
     parser.add_argument("--batch", type=int, default=59)
     parser.add_argument("--k", type=int, default=3)
     parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument(
+        "--export-dir", default=None,
+        help="after training, serialize predict + weights to this dir as a "
+             "StableHLO serving artifact (estimator/export.py)",
+    )
     args = parser.parse_args(argv)
 
     import numpy as np
@@ -71,6 +76,11 @@ def main(argv=None):
     for i, p in enumerate(preds):  # predict 5 (another-example.py:385-389)
         print(f"  predict[{i}] = {float(p['predictions'][0]):.3f} "
               f"(label {float(y[te][i, 0]):.3f})")
+    if args.export_dir:
+        blob = est.export_model(
+            args.export_dir, {"x": X[te][:1], "y": y[te][:1]}, state=state
+        )
+        print(f"exported serving artifact: {blob}")
     return test_res
 
 
