@@ -1,4 +1,4 @@
-from gradaccum_tpu.models import bert, housing_mlp, mnist_cnn
+from gradaccum_tpu.models import bert, bert_pp, gpt, housing_mlp, mnist_cnn
 from gradaccum_tpu.models.bert import (
     BertClassifier,
     BertConfig,
@@ -6,5 +6,6 @@ from gradaccum_tpu.models.bert import (
     bert_classifier_bundle,
     dense_attention,
 )
+from gradaccum_tpu.models.gpt import GPTConfig, GPTLM, gpt_lm_bundle
 from gradaccum_tpu.models.housing_mlp import HousingMLP, housing_mlp_bundle
 from gradaccum_tpu.models.mnist_cnn import MnistCNN, mnist_cnn_bundle

@@ -1,0 +1,224 @@
+"""GPT-style decoder-only causal language model.
+
+A model family beyond the reference (which fine-tunes encoder-only BERT,
+/root/reference/README.md:60-78): pre-LayerNorm transformer decoder with
+causal masking, learned positions, and a weight-tied LM head — the GPT-2
+recipe. Built from the same attention machinery as models/bert.py (the
+``attention_fn`` slot accepts the dense, flash, ring, or ulysses cores) and
+with the SAME parameter naming scheme (``query/key/value``, ``intermediate``,
+``ffn_output``, ``word_embeddings``), so :func:`parallel.tp.bert_tp_rules`
+tensor-shards this model unchanged and the whole Estimator surface (grad
+accumulation, dp/tp/zero1, checkpointing, export) applies as-is.
+
+TPU-first choices mirror bert.py: bf16 compute path with f32 params, f32
+logits/loss, optional per-layer remat.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from gradaccum_tpu.estimator.estimator import ModelBundle
+from gradaccum_tpu.estimator.metrics import Metric
+from gradaccum_tpu.models.bert import SelfAttention, dense_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 512
+    num_layers: int = 4
+    num_heads: int = 8
+    intermediate_size: int = 2048
+    max_position_embeddings: int = 512
+    dropout: float = 0.1
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    remat: bool = False
+
+    @staticmethod
+    def small(**kw) -> "GPTConfig":
+        return GPTConfig(**kw)
+
+    @staticmethod
+    def tiny_for_tests(**kw) -> "GPTConfig":
+        return GPTConfig(
+            vocab_size=96, hidden_size=32, num_layers=2, num_heads=2,
+            intermediate_size=64, max_position_embeddings=64, **kw
+        )
+
+
+def _bert_cfg_view(cfg: GPTConfig):
+    """SelfAttention reads BertConfig-shaped fields; give it a view."""
+    from gradaccum_tpu.models.bert import BertConfig
+
+    return BertConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        num_layers=cfg.num_layers,
+        num_heads=cfg.num_heads,
+        intermediate_size=cfg.intermediate_size,
+        max_position_embeddings=cfg.max_position_embeddings,
+        hidden_dropout=cfg.dropout,
+        attention_dropout=cfg.dropout,
+        layer_norm_eps=cfg.layer_norm_eps,
+        dtype=cfg.dtype,
+    )
+
+
+class DecoderBlock(nn.Module):
+    """Pre-LN: x + attn(LN(x)); x + mlp(LN(x)) — GPT-2's residual layout
+    (vs the post-LN EncoderLayer of bert.py)."""
+
+    config: GPTConfig
+    attention_fn: Callable = dense_attention
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.config
+        bcfg = _bert_cfg_view(cfg)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="attention_LayerNorm")(x)
+        h = SelfAttention(bcfg, self.attention_fn, name="attention")(
+            h, mask, deterministic
+        )
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        x = x + h
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="mlp_LayerNorm")(x)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="intermediate")(h)
+        h = nn.gelu(h, approximate=True)  # GPT-2 uses tanh-approximate gelu
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="ffn_output")(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return x + h
+
+
+class GPTLM(nn.Module):
+    config: GPTConfig
+    attention_fn: Callable = dense_attention
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True):
+        cfg = self.config
+        B, S = input_ids.shape
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         name="word_embeddings")
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                       dtype=cfg.dtype, name="position_embeddings")
+        x = embed(input_ids) + pos(jnp.arange(S)[None, :])
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        # causal additive mask [1, 1, S, S]: position q attends keys <= q
+        causal = jnp.tril(jnp.ones((S, S), jnp.float32))
+        mask = ((1.0 - causal) * -1e9).astype(cfg.dtype)[None, None, :, :]
+
+        block_cls = DecoderBlock
+        if cfg.remat:
+            block_cls = nn.remat(DecoderBlock, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, self.attention_fn, name=f"layer_{i}")(
+                x, mask, deterministic
+            )
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="final_LayerNorm")(x)
+        # weight-tied LM head: logits = x @ E^T in f32
+        logits = jnp.einsum(
+            "bsd,vd->bsv",
+            x.astype(jnp.float32),
+            embed.embedding.astype(jnp.float32),
+        )
+        return logits
+
+
+def next_token_loss(logits, input_ids, loss_mask=None):
+    """Mean causal-LM cross-entropy: position t predicts token t+1.
+
+    ``loss_mask`` ([B, S] 0/1): positions whose NEXT token should count;
+    defaults to all S-1 shifted positions.
+    """
+    targets = input_ids[:, 1:]  # [B, S-1]
+    lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
+    if loss_mask is None:
+        return jnp.mean(nll)
+    w = loss_mask[:, : targets.shape[1]].astype(nll.dtype)
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def token_accuracy() -> Metric:
+    """Streaming next-token accuracy over non-masked positions."""
+
+    def update(outputs, batch):
+        logits = outputs["logits"][:, :-1]
+        targets = batch["input_ids"][:, 1:]
+        hit = (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
+        mask = batch.get("loss_mask")
+        if mask is None:
+            return jnp.sum(hit), jnp.asarray(hit.size, jnp.float32)
+        w = mask[:, : targets.shape[1]].astype(jnp.float32)
+        return jnp.sum(hit * w), jnp.sum(w)
+
+    return Metric(update=update, finalize=lambda t, c: t / jnp.maximum(c, 1.0))
+
+
+def gpt_lm_bundle(
+    config: GPTConfig,
+    attention_fn: Callable = dense_attention,
+) -> ModelBundle:
+    """ModelBundle for causal-LM training: batches ``{"input_ids": [B, S]
+    int32}`` (+ optional ``"loss_mask"`` [B, S]); harness injects ``"rng"``
+    for dropout."""
+    model = GPTLM(config, attention_fn)
+
+    def init(rng, sample):
+        variables = model.init(
+            {"params": rng, "dropout": rng}, sample["input_ids"], True
+        )
+        return {"params": variables["params"]}
+
+    def loss(params, batch):
+        logits = model.apply(
+            params, batch["input_ids"], False, rngs={"dropout": batch["rng"]}
+        )
+        return next_token_loss(logits, batch["input_ids"], batch.get("loss_mask"))
+
+    def predict(params, batch):
+        logits = model.apply(params, batch["input_ids"], True)
+        return {
+            "logits": logits,
+            "next_token": jnp.argmax(logits[:, -1], axis=-1),
+        }
+
+    return ModelBundle(
+        init=init,
+        loss=loss,
+        predict=predict,
+        eval_metrics={"token_accuracy": token_accuracy()},
+        needs_rng=True,
+    )
+
+
+def greedy_generate(params, bundle_or_model, prompt_ids, num_steps: int):
+    """Greedy decoding for smoke tests: append argmax token ``num_steps``
+    times (re-runs the full prefix each step — fine at test scale; a KV
+    cache belongs in a serving stack, not the training framework)."""
+    model = (
+        bundle_or_model if isinstance(bundle_or_model, GPTLM) else None
+    )
+    ids = jnp.asarray(prompt_ids)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    for _ in range(num_steps):
+        if model is not None:
+            logits = model.apply(params, ids, True)
+            nxt = jnp.argmax(logits[:, -1], axis=-1)
+        else:
+            out = bundle_or_model.predict(params, {"input_ids": ids})
+            nxt = out["next_token"]
+        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+    return ids

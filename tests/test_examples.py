@@ -126,3 +126,11 @@ def test_bert_entrypoint_flag_validation(tmp_path):
         # this errors after data prep, so confine the model-dir side effect
         _run_example("bert_finetune", ["--pp", "3",
                                        "--model-dir", str(tmp_path / "x")])
+
+
+def test_gpt_entrypoint_smoke(tmp_path):
+    res = _run_example("gpt_lm", [
+        "--max-steps", "8", "--seq-len", "32", "--batch", "8",
+        "--sample", "0", "--model-dir", str(tmp_path / "g"),
+    ])
+    assert 0.0 <= res["token_accuracy"] <= 1.0
