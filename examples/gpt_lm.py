@@ -45,6 +45,11 @@ def main(argv=None):
                         help="tensor-parallel width (bert_tp_rules apply "
                              "unchanged — shared parameter naming)")
     parser.add_argument("--zero1", action="store_true")
+    parser.add_argument(
+        "--flash", action="store_true",
+        help="causal Pallas flash attention (kernel-side triangle, "
+             "above-diagonal key blocks skipped; forces dropout=0)",
+    )
     parser.add_argument("--export-dir", default=None)
     parser.add_argument("--sample", type=int, default=40,
                         help="greedy-decode this many chars after training")
@@ -78,8 +83,14 @@ def main(argv=None):
     cfg = GPTConfig(
         vocab_size=256, hidden_size=128, num_layers=4, num_heads=4,
         intermediate_size=512, max_position_embeddings=max(64, S),
+        dropout=0.0 if args.flash else 0.1,
     )
-    bundle = gpt_lm_bundle(cfg)
+    if args.flash:
+        from gradaccum_tpu.ops.flash_attention import causal_flash_attention
+
+        bundle = gpt_lm_bundle(cfg, attention_fn=causal_flash_attention)
+    else:
+        bundle = gpt_lm_bundle(cfg)
 
     mesh, rules = None, None
     n_mesh = args.dp * args.tp

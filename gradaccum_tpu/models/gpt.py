@@ -113,9 +113,13 @@ class GPTLM(nn.Module):
         x = embed(input_ids) + pos(jnp.arange(S)[None, :])
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
-        # causal additive mask [1, 1, S, S]: position q attends keys <= q
-        causal = jnp.tril(jnp.ones((S, S), jnp.float32))
-        mask = ((1.0 - causal) * -1e9).astype(cfg.dtype)[None, None, :, :]
+        if getattr(self.attention_fn, "handles_causality", False):
+            # kernel-side causality (causal_flash_attention): no dense mask
+            mask = None
+        else:
+            # causal additive mask [1, 1, S, S]: position q attends keys <= q
+            causal = jnp.tril(jnp.ones((S, S), jnp.float32))
+            mask = ((1.0 - causal) * -1e9).astype(cfg.dtype)[None, None, :, :]
 
         block_cls = DecoderBlock
         if cfg.remat:

@@ -34,12 +34,19 @@ from gradaccum_tpu.parallel.ring_attention import blockwise_attention
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref, *, scale):
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, bq, bk):
     """Grid (B, H, num_q_blocks, num_k_blocks); refs are one block each.
 
     Block shapes: q/o [1,1,bq,D], k/v [1,1,bk,D], mask [1,1,1,bk]; scratch
     acc [bq,D], m/l [bq,1] — all float32, carried across the k dimension.
+
+    ``causal``: key blocks strictly above the diagonal contribute nothing —
+    their whole update is skipped (the MXU work halves at long S; the DMA
+    still streams, which Mosaic overlaps anyway) — and the diagonal block
+    applies the intra-block triangle.
     """
+    iq = pl.program_id(2)
     ik = pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -49,33 +56,44 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref, *, 
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0, 0]  # [bq, D]
-    k = k_ref[0, 0]  # [bk, D]
-    v = v_ref[0, 0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [bq, bk]
-    if mask_ref is not None:
-        s = s + mask_ref[0, 0].astype(jnp.float32)  # [1, bk] broadcasts
+    def _update():
+        q = q_ref[0, 0]  # [bq, D]
+        k = k_ref[0, 0]  # [bk, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if mask_ref is not None:
+            s = s + mask_ref[0, 0].astype(jnp.float32)  # [1, bk] broadcasts
+        if causal:
+            q_pos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+            k_pos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
+            s = jnp.where(k_pos > q_pos, _NEG_INF, s)
 
-    m_prev, l_prev = m_ref[:], l_ref[:]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    correction = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)
-    l_ref[:] = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
-    pv = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc_ref[:] = acc_ref[:] * correction + pv
-    m_ref[:] = m_new
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        correction = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[:] = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * correction + pv
+        m_ref[:] = m_new
+
+    if causal:
+        # first key index of this block <= last query index of this block?
+        pl.when(ik * bk <= iq * bq + (bq - 1))(_update)
+    else:
+        _update()
 
     @pl.when(ik == nk - 1)
     def _finalize():
         o_ref[0, 0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
 
 
-def _flash_forward(q, k, v, mask, block_q, block_k, interpret):
+def _flash_forward(q, k, v, mask, block_q, block_k, interpret, causal=False):
     b, h, s, d = q.shape
     bq, bk = min(block_q, s), min(block_k, s)
     if s % bq or s % bk:
@@ -99,18 +117,19 @@ def _flash_forward(q, k, v, mask, block_q, block_k, interpret):
 
     in_specs = [q_spec, kv_spec, kv_spec]
     operands = [q, k, v]
+    common = dict(scale=scale, causal=causal, bq=bq, bk=bk)
     if mask is not None:
         in_specs.append(
             pl.BlockSpec((1, 1, 1, bk), lambda b_, h_, iq, ik: (b_, 0, 0, ik))
         )
         operands.append(mask)
-        kernel = functools.partial(_fwd_kernel, scale=scale)
+        kernel = functools.partial(_fwd_kernel, **common)
     else:
         kernel = functools.partial(
-            lambda qr, kr, vr, orf, a, m, l, *, scale: _fwd_kernel(
-                qr, kr, vr, None, orf, a, m, l, scale=scale
+            lambda qr, kr, vr, orf, a, m, l, **kw: _fwd_kernel(
+                qr, kr, vr, None, orf, a, m, l, **kw
             ),
-            scale=scale,
+            **common,
         )
 
     # b/h/q-block programs are independent; only the k-block axis carries
@@ -137,26 +156,33 @@ def _flash_forward(q, k, v, mask, block_q, block_k, interpret):
     )(*operands)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, mask, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, mask, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, mask, block_q, block_k, interpret, causal):
+    return _flash_forward(q, k, v, mask, block_q, block_k, interpret, causal)
 
 
-def _flash_fwd(q, k, v, mask, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, mask, block_q, block_k, interpret), (q, k, v, mask)
+def _flash_fwd(q, k, v, mask, block_q, block_k, interpret, causal):
+    return (
+        _flash_forward(q, k, v, mask, block_q, block_k, interpret, causal),
+        (q, k, v, mask),
+    )
 
 
-def _flash_bwd(block_q, block_k, interpret, residuals, g):
+def _flash_bwd(block_q, block_k, interpret, causal, residuals, g):
     q, k, v, mask = residuals
     # recompute-based backward through the XLA blockwise core: same online
     # softmax, O(S·block) memory, exact gradients — including d(mask), so a
     # learned additive bias (ALiBi/relative-position style) trains correctly
     if mask is None:
-        f = lambda q_, k_, v_: blockwise_attention(q_, k_, v_, None, block_size=block_k)
+        f = lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, None, block_size=block_k, causal=causal
+        )
         _, vjp = jax.vjp(f, q, k, v)
         dq, dk, dv = vjp(g)
         return dq, dk, dv, None
-    f = lambda q_, k_, v_, m_: blockwise_attention(q_, k_, v_, m_, block_size=block_k)
+    f = lambda q_, k_, v_, m_: blockwise_attention(
+        q_, k_, v_, m_, block_size=block_k, causal=causal
+    )
     _, vjp = jax.vjp(f, q, k, v, mask)
     return vjp(g)
 
@@ -174,12 +200,16 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    causal: bool = False,
 ):
     """Fused attention: drop-in for ``models.bert.dense_attention``.
 
     ``q,k,v``: [B, heads, S, head_dim]; ``mask``: additive key mask
-    [B, 1, 1, S] or None. Differentiable (custom VJP). ``interpret=None``
-    auto-selects interpreter mode off-TPU.
+    [B, 1, 1, S] or None. ``causal=True`` applies the autoregressive
+    triangle inside the kernel (above-diagonal key blocks are skipped
+    entirely — never build a dense [S,S] causal mask for this kernel).
+    Differentiable (custom VJP). ``interpret=None`` auto-selects
+    interpreter mode off-TPU.
     """
     if dropout_fn is not None:
         raise NotImplementedError(
@@ -188,4 +218,15 @@ def flash_attention(
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, mask, block_q, block_k, interpret)
+    return _flash(q, k, v, mask, block_q, block_k, interpret, causal)
+
+
+def causal_flash_attention(q, k, v, mask=None, dropout_fn=None, **kw):
+    """``attention_fn`` slot for decoder models (``models.gpt.GPTLM``):
+    causality lives inside the kernel, so the model must NOT also pass a
+    dense [S,S] causal mask (``handles_causality`` advertises that). A key
+    padding mask [B,1,1,S] still composes."""
+    return flash_attention(q, k, v, mask, dropout_fn, causal=True, **kw)
+
+
+causal_flash_attention.handles_causality = True

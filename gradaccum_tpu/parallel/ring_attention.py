@@ -89,12 +89,15 @@ def _check_no_dropout(dropout_fn, name):
         )
 
 
-def blockwise_attention(q, k, v, mask=None, dropout_fn=None, *, block_size: int = 512):
+def blockwise_attention(q, k, v, mask=None, dropout_fn=None, *,
+                        block_size: int = 512, causal: bool = False):
     """Memory-efficient single-device attention: scan over key/value blocks.
 
     Exact (up to float reassociation) equivalent of ``dense_attention`` with
     O(S·block) peak memory instead of O(S²). ``block_size`` is clamped to S
-    and must divide it (pad upstream otherwise).
+    and must divide it (pad upstream otherwise). ``causal`` applies the
+    autoregressive triangle as a per-block [S, block] additive bias —
+    still never materializing [S, S].
     """
     _check_no_dropout(dropout_fn, "blockwise_attention")
     b, h, s, d = q.shape
@@ -104,19 +107,39 @@ def blockwise_attention(q, k, v, mask=None, dropout_fn=None, *, block_size: int 
     n_blocks = s // block
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(q.dtype)
 
+    q_pos = jnp.arange(s)[:, None]  # [S, 1]
+
+    def causal_bias(j):
+        # [1, 1, S, block] additive bias for key block j
+        k_pos = j * block + jnp.arange(block)[None, :]
+        return jnp.where(k_pos > q_pos, _NEG_INF, 0.0)[None, None]
+
+    def merge(mask_blk, j):
+        if not causal:
+            return mask_blk
+        bias = causal_bias(j)
+        return bias if mask_blk is None else mask_blk + bias
+
     if n_blocks == 1:
-        o, _, l = _online_block(_init_carry(q), q, k, v, mask, scale)
+        o, _, l = _online_block(
+            _init_carry(q), q, k, v, merge(mask, 0), scale
+        )
         return (o / l).astype(q.dtype)
 
     k_blocks = k.reshape(b, h, n_blocks, block, d).transpose(2, 0, 1, 3, 4)
     v_blocks = v.reshape(b, h, n_blocks, block, d).transpose(2, 0, 1, 3, 4)
+    idx = jnp.arange(n_blocks)
     if mask is not None:
         mask_blocks = mask.reshape(b, 1, 1, n_blocks, block).transpose(3, 0, 1, 2, 4)
-        xs = (k_blocks, v_blocks, mask_blocks)
-        body = lambda c, x: (_online_block(c, q, x[0], x[1], x[2], scale), None)
+        xs = (k_blocks, v_blocks, mask_blocks, idx)
+        body = lambda c, x: (
+            _online_block(c, q, x[0], x[1], merge(x[2], x[3]), scale), None
+        )
     else:
-        xs = (k_blocks, v_blocks)
-        body = lambda c, x: (_online_block(c, q, x[0], x[1], None, scale), None)
+        xs = (k_blocks, v_blocks, idx)
+        body = lambda c, x: (
+            _online_block(c, q, x[0], x[1], merge(None, x[2]), scale), None
+        )
 
     (o, _, l), _ = lax.scan(body, _init_carry(q), xs)
     return (o / l).astype(q.dtype)
