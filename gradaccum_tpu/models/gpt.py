@@ -207,22 +207,30 @@ def gpt_lm_bundle(
     )
 
 
-def greedy_generate(params, bundle_or_model, prompt_ids, num_steps: int):
-    """Greedy decoding for smoke tests: append argmax token ``num_steps``
-    times (re-runs the full prefix each step — fine at test scale; a KV
-    cache belongs in a serving stack, not the training framework)."""
+def greedy_generate(params, bundle_or_model, prompt_ids, num_steps: int,
+                    temperature: float = 0.0, rng=None):
+    """Decoding for smoke tests: append ``num_steps`` tokens, greedy by
+    default or temperature-sampled when ``temperature > 0`` (pass ``rng``).
+    Re-runs the full prefix each step — fine at test scale; a KV cache
+    belongs in a serving stack, not the training framework."""
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
     model = (
         bundle_or_model if isinstance(bundle_or_model, GPTLM) else None
     )
     ids = jnp.asarray(prompt_ids)
     if ids.ndim == 1:
         ids = ids[None, :]
-    for _ in range(num_steps):
+    for i in range(num_steps):
         if model is not None:
-            logits = model.apply(params, ids, True)
-            nxt = jnp.argmax(logits[:, -1], axis=-1)
+            last = model.apply(params, ids, True)[:, -1]
         else:
-            out = bundle_or_model.predict(params, {"input_ids": ids})
-            nxt = out["next_token"]
+            last = bundle_or_model.predict(params, {"input_ids": ids})["logits"][:, -1]
+        if temperature > 0:
+            nxt = jax.random.categorical(
+                jax.random.fold_in(rng, i), last / temperature, axis=-1
+            )
+        else:
+            nxt = jnp.argmax(last, axis=-1)
         ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
     return ids

@@ -168,3 +168,22 @@ def test_loss_mask(rng):
     )
     np.testing.assert_allclose(float(half), manual, rtol=1e-6)
     assert abs(float(full) - float(half)) > 1e-6
+
+
+def test_temperature_sampling(rng):
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    prompt = rng.integers(0, cfg.vocab_size, size=(4,)).astype(np.int32)
+    params = bundle.init(jax.random.PRNGKey(0), {"input_ids": prompt[None, :]})
+
+    greedy = greedy_generate(params, bundle, prompt, num_steps=6)
+    same = greedy_generate(params, bundle, prompt, num_steps=6)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(same))
+
+    s1 = greedy_generate(params, bundle, prompt, num_steps=6,
+                         temperature=2.0, rng=jax.random.PRNGKey(1))
+    s2 = greedy_generate(params, bundle, prompt, num_steps=6,
+                         temperature=2.0, rng=jax.random.PRNGKey(2))
+    assert not np.array_equal(np.asarray(s1), np.asarray(s2))
+    with pytest.raises(ValueError, match="rng"):
+        greedy_generate(params, bundle, prompt, 2, temperature=1.0)
