@@ -56,6 +56,9 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if min(args.dp, args.tp) < 1:
         parser.error("--dp/--tp must be >= 1")
+    if args.zero1 and args.dp < 2:
+        # validate BEFORE prepare_model_dir wipes the run directory
+        parser.error("--zero1 needs --dp >= 2 (moments shard over 'data')")
 
     from gradaccum_tpu.utils.platform import honor_cpu_platform_request
 
@@ -82,7 +85,9 @@ def main(argv=None):
 
     cfg = GPTConfig(
         vocab_size=256, hidden_size=128, num_layers=4, num_heads=4,
-        intermediate_size=512, max_position_embeddings=max(64, S),
+        # sampling appends --sample tokens past the S//2 prompt: size the
+        # position table for the longest sequence the run will ever see
+        max_position_embeddings=max(64, S, S // 2 + args.sample),
         dropout=0.0 if args.flash else 0.1,
     )
     if args.flash:
@@ -91,6 +96,9 @@ def main(argv=None):
         bundle = gpt_lm_bundle(cfg, attention_fn=causal_flash_attention)
     else:
         bundle = gpt_lm_bundle(cfg)
+    # decode lengths vary token by token; the flash kernel needs block
+    # multiples, so sampling always runs the dense core (same params)
+    sample_bundle = gpt_lm_bundle(cfg) if args.flash else bundle
 
     mesh, rules = None, None
     n_mesh = args.dp * args.tp
@@ -147,7 +155,8 @@ def main(argv=None):
 
     if args.sample > 0:
         prompt = train[0][: S // 2]
-        out = greedy_generate(state.params, bundle, prompt, num_steps=args.sample)
+        out = greedy_generate(state.params, sample_bundle, prompt,
+                              num_steps=args.sample)
         txt = bytes(int(t) for t in np.asarray(out[0])).decode("utf-8", "replace")
         print(f"sample: {txt!r}")
     if args.export_dir:

@@ -106,6 +106,13 @@ class GPTLM(nn.Module):
     def __call__(self, input_ids, deterministic: bool = True):
         cfg = self.config
         B, S = input_ids.shape
+        if S > cfg.max_position_embeddings:
+            # XLA's gather clamps out-of-range indices, which would silently
+            # reuse the last position row — fail loudly instead
+            raise ValueError(
+                f"sequence length {S} exceeds max_position_embeddings "
+                f"{cfg.max_position_embeddings}"
+            )
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          name="word_embeddings")
         pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
