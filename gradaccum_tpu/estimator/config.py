@@ -44,3 +44,14 @@ class EvalSpec:
     steps: Optional[int] = None  # None = run the iterable out
     throttle_secs: int = 30  # min seconds between evals (another-example.py:318)
     name: str = "eval"
+    # tf.estimator.BestExporter slot: after every eval during
+    # train_and_evaluate, if `best_metric` improved (per `best_mode`), the
+    # current weights are exported as a serving artifact (estimator/export.py)
+    # into `export_best_dir`, alongside best_metric.json ({metric, value,
+    # step}) — which also persists the high-water mark across resumes.
+    export_best_dir: Optional[str] = None
+    best_metric: str = "accuracy"
+    best_mode: str = "max"  # or "min" (e.g. rmse)
+    # dict batch fixing the serving signature; defaults to the first eval
+    # batch (then EVERY batch key, labels included, becomes a serving input)
+    export_sample: Optional[Any] = None

@@ -612,10 +612,48 @@ class Estimator:
             batch_polymorphic=batch_polymorphic,
         )
 
+    def _maybe_export_best(self, eval_spec: EvalSpec, results, state):
+        """tf.estimator.BestExporter parity: export the serving artifact
+        when ``eval_spec.best_metric`` improves; ``best_metric.json``
+        persists the high-water mark (so resumes don't regress it)."""
+        if eval_spec.export_best_dir is None:
+            return
+        if eval_spec.best_mode not in ("max", "min"):
+            raise ValueError(f"best_mode must be 'max' or 'min', got "
+                             f"{eval_spec.best_mode!r}")
+        metric = eval_spec.best_metric
+        if metric not in results:
+            raise KeyError(
+                f"best_metric {metric!r} not in eval results {sorted(results)}"
+            )
+        value = float(results[metric])
+        import json
+
+        marker = os.path.join(eval_spec.export_best_dir, "best_metric.json")
+        best = None
+        if os.path.exists(marker):
+            with open(marker) as f:
+                best = json.load(f).get("value")
+        improved = best is None or (
+            value > best if eval_spec.best_mode == "max" else value < best
+        )
+        if not improved:
+            return
+        sample = eval_spec.export_sample
+        if sample is None:
+            sample = next(iter(eval_spec.input_fn()))
+        self.export_model(eval_spec.export_best_dir, sample, state=state)
+        with open(marker, "w") as f:
+            json.dump({"metric": metric, "value": value,
+                       "step": int(jax.device_get(state.step))}, f)
+        print(f"[best] exported {metric}={value:.5f} "
+              f"to {eval_spec.export_best_dir}")
+
     def train_and_evaluate(self, train_spec: TrainSpec, eval_spec: EvalSpec):
         """``tf.estimator.train_and_evaluate`` parity: train in chunks,
         evaluating at most every ``throttle_secs`` (another-example.py:318),
-        plus a final eval."""
+        plus a final eval. With ``eval_spec.export_best_dir`` set, each
+        improving eval refreshes a serving export (BestExporter)."""
         import itertools
 
         last_eval = 0.0
@@ -650,12 +688,14 @@ class Estimator:
                     eval_spec.input_fn, steps=eval_spec.steps, state=state,
                     name=eval_spec.name,
                 )
+                self._maybe_export_best(eval_spec, results, state)
                 return state, results
             if time.time() - last_eval >= eval_spec.throttle_secs:
                 results = self.evaluate(
                     eval_spec.input_fn, steps=eval_spec.steps, state=state,
                     name=eval_spec.name,
                 )
+                self._maybe_export_best(eval_spec, results, state)
                 last_eval = time.time()
 
     # -- helpers ---------------------------------------------------------

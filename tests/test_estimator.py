@@ -608,3 +608,58 @@ def test_export_model_bert(rng, tmp_path):
     np.testing.assert_array_equal(
         np.asarray(got["classes"]), np.asarray(want["classes"])
     )
+
+
+def test_best_exporter(rng, tmp_path):
+    """EvalSpec.export_best_dir keeps the best serving artifact: improving
+    evals refresh it, worse evals leave it; the marker persists the
+    high-water mark across a fresh train_and_evaluate (resume)."""
+    import json
+
+    from gradaccum_tpu.estimator.export import load_exported, load_manifest
+
+    best_dir = str(tmp_path / "best")
+    data = _regression_data(rng, 128)
+    sample = {"x": data["x"][:2], "y": data["y"][:2]}
+
+    def fresh():
+        return Estimator(
+            _linear_bundle(), adam(5e-2),
+            GradAccumConfig(num_micro_batches=K, first_step_quirk=False),
+            RunConfig(model_dir=str(tmp_path / "m"), log_step_count_steps=20),
+            mode="streaming",
+        )
+
+    spec = lambda: EvalSpec(
+        _input_fn(rng, 128, 64, epochs=1), throttle_secs=0,
+        export_best_dir=best_dir, best_metric="rmse", best_mode="min",
+        export_sample=sample,
+    )
+    state, results = fresh().train_and_evaluate(
+        TrainSpec(_input_fn(rng, 256, B), max_steps=60), spec()
+    )
+    marker = json.loads((tmp_path / "best" / "best_metric.json").read_text())
+    assert marker["metric"] == "rmse"
+    assert marker["value"] <= results["rmse"] + 1e-9
+    first_best = marker["value"]
+
+    served = load_exported(best_dir)(sample)
+    assert served["predictions"].shape == (2, 1)
+    assert load_manifest(best_dir)["inputs"]["x"]["shape"] == [2, 3]
+
+    # resumed run (restores from model_dir): continues improving or leaves
+    # the marker; it must never regress
+    state, _ = fresh().train_and_evaluate(
+        TrainSpec(_input_fn(rng, 256, B), max_steps=120), spec()
+    )
+    marker2 = json.loads((tmp_path / "best" / "best_metric.json").read_text())
+    assert marker2["value"] <= first_best + 1e-9
+
+    # a bogus metric name fails loudly
+    bad = EvalSpec(_input_fn(rng, 128, 64, epochs=1), throttle_secs=0,
+                   export_best_dir=best_dir, best_metric="nope")
+    import pytest as _pytest
+    with _pytest.raises(KeyError, match="nope"):
+        fresh().train_and_evaluate(
+            TrainSpec(_input_fn(rng, 256, B), max_steps=130), bad
+        )
