@@ -155,6 +155,11 @@ def main(argv=None):
         help="after training, serialize predict + weights to this dir as a "
              "StableHLO serving artifact (estimator/export.py)",
     )
+    parser.add_argument(
+        "--export-best-dir", default=None,
+        help="BestExporter slot: every improving eval during training "
+             "refreshes a serving export here (best accuracy)",
+    )
     parser.add_argument("--full", action="store_true",
                         help="reference scale: 3 epochs over the corpus")
     parser.add_argument(
@@ -414,7 +419,12 @@ def main(argv=None):
 
     state, results = est.train_and_evaluate(
         gt.TrainSpec(train_fn, max_steps=max_steps),
-        gt.EvalSpec(eval_fn, throttle_secs=60),
+        gt.EvalSpec(
+            eval_fn, throttle_secs=60,
+            export_best_dir=args.export_best_dir,
+            best_metric="accuracy", best_mode="max",
+            export_sample={k: v[:1] for k, v in evald.items() if k != "label"},
+        ),
     )
     print(f"{args.task}: eval accuracy {results['accuracy']:.4f} "
           f"(effective batch {micro * k}, loss CSV in {model_dir})")
