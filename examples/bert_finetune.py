@@ -183,6 +183,10 @@ def main(argv=None):
         parser.error("--dp/--tp/--ep/--sp/--pp must be >= 1")
     if args.ep > 1 and (args.num_experts == 0 or args.num_experts % args.ep):
         parser.error("--ep requires --num-experts divisible by it")
+    if args.moe_top_k < 1 or (args.num_experts and args.moe_top_k > args.num_experts):
+        parser.error("--moe-top-k must be in [1, --num-experts]")
+    if args.moe_top_k > 1 and args.num_experts == 0:
+        parser.error("--moe-top-k needs --num-experts")
     if args.sp > 1 and (args.tp > 1 or args.ep > 1):
         parser.error("--sp composes with --dp only (shard_map path)")
     if args.sp > 1 and args.mode != "scan":
