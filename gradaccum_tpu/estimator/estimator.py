@@ -43,6 +43,10 @@ class ModelBundle(NamedTuple):
     predict: Callable[[Any, Any], Dict[str, Any]]  # (params, batch) -> outputs
     eval_metrics: Dict[str, Metric]
     needs_rng: bool = False  # if True, batches get an "rng" key folded per step
+    # batch keys ``predict`` never reads (the reference's ``labels`` argument,
+    # SURVEY §1 model layer): stripped when an eval batch is used as the
+    # default serving signature so exports don't require label inputs
+    label_keys: tuple = ("label",)
 
 
 class Estimator:
@@ -642,6 +646,13 @@ class Estimator:
         sample = eval_spec.export_sample
         if sample is None:
             sample = next(iter(eval_spec.input_fn()))
+            if isinstance(sample, dict):
+                stripped = [k for k in sample if k in self.eval_model.label_keys]
+                sample = {k: v for k, v in sample.items() if k not in stripped}
+                if stripped:
+                    print(f"[best] export signature from first eval batch, "
+                          f"label key(s) {stripped} stripped; set "
+                          f"EvalSpec.export_sample to control it")
         self.export_model(eval_spec.export_best_dir, sample, state=state)
         with open(marker, "w") as f:
             json.dump({"metric": metric, "value": value,

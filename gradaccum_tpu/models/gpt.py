@@ -211,6 +211,7 @@ def gpt_lm_bundle(
         predict=predict,
         eval_metrics={"token_accuracy": token_accuracy()},
         needs_rng=True,
+        label_keys=(),  # the LM's targets ARE input_ids (shifted internally)
     )
 
 

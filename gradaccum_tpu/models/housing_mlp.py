@@ -55,4 +55,5 @@ def housing_mlp_bundle(hidden: Sequence[int] = (16, 8, 4)) -> ModelBundle:
             "mae": mean_absolute_error(label_key="y"),
             "rmse": root_mean_squared_error(label_key="y"),
         },
+        label_keys=("y",),
     )

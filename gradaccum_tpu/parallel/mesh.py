@@ -125,13 +125,24 @@ def make_hybrid_mesh(
     from jax.experimental import mesh_utils
 
     ici_sizes = [s for _, s in ici_axes]
-    try:
+    total = int(np.prod(dcn_sizes)) * int(np.prod(ici_sizes))
+    if len(devices) != total:
+        raise ValueError(
+            f"hybrid mesh axes {list(dcn_axes)} x {list(ici_axes)} need "
+            f"{total} devices, got {len(devices)}"
+        )
+    # Slice topology is only usable when the devices actually report enough
+    # distinct slices to fill the DCN axes; CPU clusters report none (or one).
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None not in slice_ids and len(slice_ids) >= int(np.prod(dcn_sizes)) > 1:
+        # Genuine multi-slice hardware: any ValueError below is a real
+        # configuration error and propagates unchanged.
         dev_array = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=[1] * len(dcn_axes) + ici_sizes,
             dcn_mesh_shape=dcn_sizes + [1] * len(ici_axes),
             devices=devices,
         )
-    except ValueError:
+    else:
         # No slice topology (e.g. a CPU jax.distributed cluster, where every
         # device reports the same slice): treat each PROCESS as a slice —
         # DCN axes split across processes, ICI axes within one process's
