@@ -94,9 +94,9 @@ def main(argv=None):
     parser.add_argument("--bf16", action="store_true", help="bfloat16 MXU compute")
     parser.add_argument(
         "--flash", action="store_true",
-        help="Pallas flash-attention core (ops/flash_attention.py); forces "
-             "attention_dropout=0 — the kernel never materializes the "
-             "[S,S] probabilities, which is the point at long --seq-len",
+        help="Pallas flash-attention core (ops/flash_attention.py): fwd+bwd "
+             "kernels, in-kernel attention dropout, never materializes the "
+             "[S,S] probabilities — which is the point at long --seq-len",
     )
     parser.add_argument(
         "--remat", action="store_true",
@@ -282,8 +282,6 @@ def main(argv=None):
     overrides = {}
     if args.remat:
         overrides["remat"] = True
-    if args.flash:
-        overrides["attention_dropout"] = 0.0
     if args.seq_len > cfg.max_position_embeddings:
         if args.hf_checkpoint:
             # warm_start bypasses init, so the checkpoint's position table
@@ -295,6 +293,10 @@ def main(argv=None):
                 "need a model trained with a larger position embedding"
             )
         overrides["max_position_embeddings"] = args.seq_len
+    if args.flash and (args.tp > 1 or args.ep > 1):
+        # the Pallas kernel is not GSPMD-partitionable: under --tp/--ep's jit
+        # path it would fail at compile (or silently replicate) on a real mesh
+        parser.error("--flash cannot run on the GSPMD --tp/--ep path; drop --flash")
     if args.sp > 1:
         if args.flash:
             parser.error("--sp brings its own attention core; drop --flash")
@@ -389,8 +391,11 @@ def main(argv=None):
     est = gt.Estimator(
         train_bundle,
         gt.ops.adamw(schedule, weight_decay_rate=0.01),  # optimization.py:59-65
+        # first_step_quirk is a streaming-mode semantic (optimization.py:91 vs
+        # scan's one-apply-per-super-batch); pass False on the scan/pp paths so
+        # the config states what actually runs
         gt.GradAccumConfig(num_micro_batches=k, clip_norm=1.0,
-                           first_step_quirk=True),  # optimization.py:76-94
+                           first_step_quirk=(args.mode == "streaming")),
         gt.RunConfig(model_dir=model_dir, log_step_count_steps=max(max_steps // 20, 1),
                      flops_per_example=bert_train_flops_per_seq(
                          cfg.hidden_size, cfg.num_layers, cfg.intermediate_size,

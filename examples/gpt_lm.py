@@ -88,7 +88,9 @@ def main(argv=None):
         # sampling appends --sample tokens past the S//2 prompt: size the
         # position table for the longest sequence the run will ever see
         max_position_embeddings=max(64, S, S // 2 + args.sample),
-        dropout=0.0 if args.flash else 0.1,
+        # the flash kernel's in-kernel hash dropout handles attention
+        # dropout; hidden dropout is ordinary nn.Dropout — same rate both ways
+        dropout=0.1,
     )
     if args.flash:
         from gradaccum_tpu.ops.flash_attention import causal_flash_attention

@@ -108,11 +108,19 @@ class SelfAttention(nn.Module):
         v = split_heads(nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="value")(x))
 
         dropout_fn = None
+        extra = {}
         if cfg.attention_dropout > 0 and not deterministic:
-            dropout = nn.Dropout(cfg.attention_dropout, name="attn_dropout")
-            dropout_fn = lambda p: dropout(p, deterministic=False)
+            if getattr(self.attention_fn, "inkernel_dropout", False):
+                # flash kernels never materialize the probabilities a
+                # dropout_fn closure would act on — they take rate + rng and
+                # regenerate the keep mask in-kernel (ops/flash_attention.py)
+                extra = dict(dropout_rate=cfg.attention_dropout,
+                             dropout_rng=self.make_rng("dropout"))
+            else:
+                dropout = nn.Dropout(cfg.attention_dropout, name="attn_dropout")
+                dropout_fn = lambda p: dropout(p, deterministic=False)
 
-        ctx = self.attention_fn(q, k, v, mask, dropout_fn)
+        ctx = self.attention_fn(q, k, v, mask, dropout_fn, **extra)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], cfg.hidden_size)
         return nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="output")(ctx)
 

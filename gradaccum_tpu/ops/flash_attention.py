@@ -1,4 +1,4 @@
-"""Fused flash attention as a Pallas TPU kernel.
+"""Fused flash attention as Pallas TPU kernels — forward AND backward.
 
 The hot op of the BERT fine-tune path (the reference's flagship workload,
 /root/reference/README.md:60-78, runs attention inside google-research/bert's
@@ -10,13 +10,35 @@ normalizer ``l``, unnormalized accumulator ``acc``) live in VMEM scratch
 across the k-block grid dimension (TPU grids iterate the last axis
 sequentially, so scratch carries).
 
-Backward runs through :func:`...parallel.ring_attention.blockwise_attention`
-via ``jax.custom_vjp`` — same math, O(S·block) memory, XLA-fused — so the
-kernel is a drop-in differentiable ``attention_fn`` for
-``models.bert.BertEncoder``. Attention-probability dropout is not supported
-(probs are never materialized); set ``attention_dropout=0.0``.
+**Backward** is two more hand-scheduled kernels (FlashAttention-2 style
+recompute): the forward saves only ``o`` and the per-row logsumexp, the
+backward recomputes each score tile from q/k and the saved logsumexp —
+never materializing [S, S] — with
 
-On non-TPU backends the kernel runs in Pallas interpreter mode (the test
+- a **dq kernel** on grid (B, H, q-blocks, k-blocks): dq accumulates in VMEM
+  scratch across the sequential k dimension;
+- a **dk/dv kernel** on grid (B, H, k-blocks, q-blocks): dk/dv accumulate
+  across the sequential q dimension (and, when a mask is given, a per-head
+  d(mask) row that XLA sums over heads afterwards — so learned additive
+  biases train correctly).
+
+Both respect causal block skipping: tiles strictly above the diagonal are
+never computed (the MXU work halves at long S). Set ``bwd_impl="xla"`` to
+route the backward through the XLA blockwise core instead
+(:func:`...parallel.ring_attention.blockwise_attention` under ``jax.vjp``)
+— same math, O(S·block) memory, useful as a cross-check.
+
+**Attention dropout** runs in-kernel: the keep/drop decision for score
+element (b, h, i, j) is a counter-based hash (murmur3 finalizer over the
+flat element index mixed with a seed), so the forward and backward kernels
+regenerate identical masks from the same scalar seed with zero extra memory
+traffic — and the mask is reproducible outside the kernel
+(:func:`dropout_keep_mask`) for exact parity tests. This replaces the
+reference's ``tf.nn.dropout`` on materialized probabilities with TPU-native
+stateless randomness (plain VPU integer ops: works compiled and in
+interpreter mode, unlike ``pltpu.prng_*`` which has no CPU lowering).
+
+On non-TPU backends the kernels run in Pallas interpreter mode (the test
 path on the 8-device virtual CPU mesh).
 """
 
@@ -33,19 +55,89 @@ from gradaccum_tpu.parallel.ring_attention import blockwise_attention
 
 _NEG_INF = -1e30
 
+# murmur3 finalizer constants + a golden-ratio seed mix: a cheap, well-mixed
+# stateless hash — quality is ample for dropout keep/drop decisions.
+# Plain ints: jnp constants built at module scope would be captured by the
+# Pallas kernel trace as closed-over arrays, which pallas_call rejects.
+_M1 = 0x85EBCA6B
+_M2 = 0xC2B2AE35
+_GOLDEN = 0x9E3779B9
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, bq, bk):
+
+def _hash_u32(x):
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(_M1)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(_M2)
+    return x ^ (x >> jnp.uint32(16))
+
+
+def _keep_from_counter(counter, seed, keep_threshold):
+    """counter: uint32 flat element index; seed: traced uint32 scalar."""
+    return _hash_u32(counter + seed * jnp.uint32(_GOLDEN)) < keep_threshold
+
+
+def _tile_keep(b, h, iq_start, ik_start, bq, bk, *, num_heads, seq, seed,
+               keep_threshold):
+    """[bq, bk] keep mask for the tile at (b, h, iq_start, ik_start).
+
+    The flat counter ((b·H + h)·S + qpos)·S + kpos wraps mod 2³² — fine, the
+    hash only needs distinct counters to stay distinct, and the SAME formula
+    runs in the forward kernel, both backward kernels, and
+    :func:`dropout_keep_mask`.
+    """
+    q_pos = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0) + jnp.uint32(iq_start)
+    k_pos = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1) + jnp.uint32(ik_start)
+    base = (jnp.uint32(b) * jnp.uint32(num_heads) + jnp.uint32(h))
+    counter = (base * jnp.uint32(seq) + q_pos) * jnp.uint32(seq) + k_pos
+    return _keep_from_counter(counter, seed, keep_threshold)
+
+
+def dropout_keep_mask(seed, batch, num_heads, seq, rate):
+    """The [B, H, S, S] keep mask the kernels derive from ``seed`` — for
+    tests: apply it to a dense reference and the kernel path must match
+    EXACTLY (same decisions), not just in expectation."""
+    keep_threshold, _ = _dropout_config(rate)
+    b = jax.lax.broadcasted_iota(jnp.uint32, (batch, num_heads, seq, seq), 0)
+    h = jax.lax.broadcasted_iota(jnp.uint32, (batch, num_heads, seq, seq), 1)
+    qp = jax.lax.broadcasted_iota(jnp.uint32, (batch, num_heads, seq, seq), 2)
+    kp = jax.lax.broadcasted_iota(jnp.uint32, (batch, num_heads, seq, seq), 3)
+    counter = ((b * jnp.uint32(num_heads) + h) * jnp.uint32(seq) + qp) * jnp.uint32(
+        seq
+    ) + kp
+    return _keep_from_counter(counter, jnp.asarray(seed, jnp.uint32), keep_threshold)
+
+
+def _dropout_config(dropout_rate):
+    keep_prob = 1.0 - dropout_rate
+    # clamp: rates tiny enough that round() hits 2^32 would wrap the uint32
+    # threshold to 0 and silently drop EVERYTHING instead of ~nothing
+    threshold = min(round(keep_prob * float(2**32)), 2**32 - 1)
+    return jnp.uint32(threshold), 1.0 / keep_prob
+
+
+# --------------------------------------------------------------------------
+# Forward kernel
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, bq, bk, num_heads,
+                seq, dropout_rate):
     """Grid (B, H, num_q_blocks, num_k_blocks); refs are one block each.
 
-    Block shapes: q/o [1,1,bq,D], k/v [1,1,bk,D], mask [1,1,1,bk]; scratch
-    acc [bq,D], m/l [bq,1] — all float32, carried across the k dimension.
+    Block shapes: q/o [1,1,bq,D], k/v [1,1,bk,D], mask [1,1,1,bk],
+    lse [1,1,bq,1]; scratch acc [bq,D], m/l [bq,1] — all float32, carried
+    across the k dimension. ``lse`` (the per-row logsumexp) is the only
+    softmax residual the backward needs.
 
     ``causal``: key blocks strictly above the diagonal contribute nothing —
     their whole update is skipped (the MXU work halves at long S; the DMA
     still streams, which Mosaic overlaps anyway) — and the diagonal block
     applies the intra-block triangle.
     """
+    bb = pl.program_id(0)  # hoisted: program_id inside a pl.when body
+    hh = pl.program_id(1)  # does not lower in interpret mode
     iq = pl.program_id(2)
     ik = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -74,7 +166,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         correction = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        # the softmax normalizer sums the UNdropped probabilities (dropout
+        # acts on the normalized matrix: O = drop(P)·V with P = softmax(S))
         l_ref[:] = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep_threshold, inv_keep = _dropout_config(dropout_rate)
+            keep = _tile_keep(
+                bb, hh, iq * bq, ik * bk, bq, bk,
+                num_heads=num_heads, seq=seq, seed=seed_ref[0, 0],
+                keep_threshold=keep_threshold,
+            )
+            p = jnp.where(keep, p * inv_keep, 0.0)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -91,10 +193,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref,
     @pl.when(ik == nk - 1)
     def _finalize():
         o_ref[0, 0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:] + jnp.log(l_ref[:])
 
 
-def _flash_forward(q, k, v, mask, block_q, block_k, interpret, causal=False):
-    b, h, s, d = q.shape
+def _block_sizes(s, block_q, block_k, mask, interpret):
     bq, bk = min(block_q, s), min(block_k, s)
     if s % bq or s % bk:
         raise ValueError(f"seq len {s} not divisible by blocks ({bq}, {bk})")
@@ -106,88 +208,390 @@ def _flash_forward(q, k, v, mask, block_q, block_k, interpret, causal=False):
             f"on TPU with a mask, block_k must be a multiple of 128 or equal "
             f"to the sequence length; got block_k={bk}, seq={s}"
         )
+    return bq, bk
+
+
+def _compiler_params(interpret, n_parallel):
+    if interpret:
+        return None
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel",) * n_parallel + ("arbitrary",)
+    )
+
+
+def _seed_operand(seed):
+    """The dropout seed rides as a (1,1) SMEM scalar."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    return jnp.asarray(seed, jnp.uint32).reshape(1, 1), spec
+
+
+def _flash_forward(q, k, v, mask, seed, block_q, block_k, interpret, causal,
+                   dropout_rate):
+    b, h, s, d = q.shape
+    bq, bk = _block_sizes(s, block_q, block_k, mask, interpret)
     grid = (b, h, s // bq, s // bk)
     scale = 1.0 / (d ** 0.5)
+
+    from jax.experimental.pallas import tpu as pltpu
 
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0))
     o_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0))
-
-    from jax.experimental.pallas import tpu as pltpu
+    lse_spec = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0))
 
     in_specs = [q_spec, kv_spec, kv_spec]
     operands = [q, k, v]
-    common = dict(scale=scale, causal=causal, bq=bq, bk=bk)
     if mask is not None:
         in_specs.append(
             pl.BlockSpec((1, 1, 1, bk), lambda b_, h_, iq, ik: (b_, 0, 0, ik))
         )
         operands.append(mask)
+    seed_arr, seed_spec = _seed_operand(seed)
+    in_specs.append(seed_spec)
+    operands.append(seed_arr)
+
+    common = dict(scale=scale, causal=causal, bq=bq, bk=bk, num_heads=h,
+                  seq=s, dropout_rate=dropout_rate)
+    if mask is not None:
         kernel = functools.partial(_fwd_kernel, **common)
     else:
         kernel = functools.partial(
-            lambda qr, kr, vr, orf, a, m, l, **kw: _fwd_kernel(
-                qr, kr, vr, None, orf, a, m, l, **kw
+            lambda qr, kr, vr, sr, orf, lr, a, m, l, **kw: _fwd_kernel(
+                qr, kr, vr, None, sr, orf, lr, a, m, l, **kw
             ),
             **common,
         )
 
     # b/h/q-block programs are independent; only the k-block axis carries
     # scratch state — tell Mosaic so it can pipeline the independent dims
-    compiler_params = None
-    if not interpret:
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
-        )
-
-    return pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ),
         grid=grid,
         in_specs=in_specs,
-        out_specs=o_spec,
+        out_specs=(o_spec, lse_spec),
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
         ],
-        compiler_params=compiler_params,
+        compiler_params=_compiler_params(interpret, 3),
+        interpret=interpret,
+    )(*operands)
+    return o, lse
+
+
+# --------------------------------------------------------------------------
+# Backward kernels
+# --------------------------------------------------------------------------
+
+
+def _recompute_tile(q_ref, k_ref, mask_ref, lse_ref, *, scale, causal, bq, bk,
+                    iq, ik):
+    """Rebuild this tile's normalized probabilities P = exp(S − lse) from the
+    saved logsumexp — the FlashAttention-2 recompute step."""
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if mask_ref is not None:
+        s = s + mask_ref[0, 0].astype(jnp.float32)
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
+        s = jnp.where(k_pos > q_pos, _NEG_INF, s)
+    return jnp.exp(s - lse_ref[0, 0])  # [bq,1] lse broadcasts over [bq,bk]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, do_ref, lse_ref,
+               delta_ref, dq_ref, dq_acc, *, scale, causal, bq, bk, num_heads,
+               seq, dropout_rate):
+    """Grid (B, H, num_q_blocks, num_k_blocks): dq for one q block
+    accumulates in scratch across the sequential k dimension.
+
+    dS = P ⊙ (dP − Δ) with dP = dO·Vᵀ (dropout-masked like the forward) and
+    Δ = rowsum(dO ⊙ O) precomputed outside; dq += dS·K · scale.
+    """
+    bb = pl.program_id(0)
+    hh = pl.program_id(1)
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _update():
+        p = _recompute_tile(q_ref, k_ref, mask_ref, lse_ref, scale=scale,
+                            causal=causal, bq=bq, bk=bk, iq=iq, ik=ik)
+        do = do_ref[0, 0]
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if dropout_rate > 0.0:
+            keep_threshold, inv_keep = _dropout_config(dropout_rate)
+            keep = _tile_keep(
+                bb, hh, iq * bq, ik * bk, bq, bk,
+                num_heads=num_heads, seq=seq, seed=seed_ref[0, 0],
+                keep_threshold=keep_threshold,
+            )
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
+        ds = p * (dp - delta_ref[0, 0])  # [bq,1] delta broadcasts
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), k_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    if causal:
+        pl.when(ik * bk <= iq * bq + (bq - 1))(_update)
+    else:
+        _update()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, do_ref, lse_ref,
+                delta_ref, dk_ref, dv_ref, dmask_ref, dk_acc, dv_acc,
+                dmask_acc, *, scale, causal, bq, bk, num_heads, seq,
+                dropout_rate):
+    """Grid (B, H, num_k_blocks, num_q_blocks): dk/dv for one k block
+    accumulate in scratch across the sequential q dimension.
+
+    dv += drop(P)ᵀ·dO; dk += dSᵀ·Q · scale. With a mask, the per-head
+    d(mask) row Σ_i dS accumulates too (summed over heads by the caller).
+    """
+    bb = pl.program_id(0)
+    hh = pl.program_id(1)
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+        if dmask_acc is not None:
+            dmask_acc[:] = jnp.zeros_like(dmask_acc)
+
+    def _update():
+        p = _recompute_tile(q_ref, k_ref, mask_ref, lse_ref, scale=scale,
+                            causal=causal, bq=bq, bk=bk, iq=iq, ik=ik)
+        do = do_ref[0, 0]
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if dropout_rate > 0.0:
+            keep_threshold, inv_keep = _dropout_config(dropout_rate)
+            keep = _tile_keep(
+                bb, hh, iq * bq, ik * bk, bq, bk,
+                num_heads=num_heads, seq=seq, seed=seed_ref[0, 0],
+                keep_threshold=keep_threshold,
+            )
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
+            p_dropped = jnp.where(keep, p * inv_keep, 0.0)
+        else:
+            p_dropped = p
+        dv_acc[:] += jax.lax.dot_general(
+            p_dropped.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0])
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0, 0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if dmask_acc is not None:
+            dmask_acc[:] += jnp.sum(ds, axis=0, keepdims=True)  # [1, bk]
+
+    if causal:
+        pl.when(iq * bq + (bq - 1) >= ik * bk)(_update)
+    else:
+        _update()
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+        if dmask_acc is not None:
+            dmask_ref[0, 0] = dmask_acc[:]
+
+
+def _flash_backward(q, k, v, mask, seed, o, lse, g, block_q, block_k,
+                    interpret, causal, dropout_rate):
+    b, h, s, d = q.shape
+    bq, bk = _block_sizes(s, block_q, block_k, mask, interpret)
+    scale = 1.0 / (d ** 0.5)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    # Δ_i = Σ_d dO_id·O_id equals rowsum(drop(P) ⊙ dP) — the softmax-backward
+    # row correction — with or without dropout; one cheap fused XLA reduce.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    common = dict(scale=scale, causal=causal, bq=bq, bk=bk, num_heads=h,
+                  seq=s, dropout_rate=dropout_rate)
+    seed_arr, seed_spec = _seed_operand(seed)
+
+    # ---- dq: grid iterates k blocks innermost ---------------------------
+    q_by_iq = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0))
+    kv_by_ik = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0))
+    row_by_iq = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0))
+    in_specs = [q_by_iq, kv_by_ik, kv_by_ik]
+    operands = [q, k, v]
+    if mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 1, 1, bk), lambda b_, h_, iq, ik: (b_, 0, 0, ik))
+        )
+        operands.append(mask)
+    in_specs += [seed_spec, q_by_iq, row_by_iq, row_by_iq]
+    operands += [seed_arr, g, lse, delta]
+
+    if mask is not None:
+        dq_kernel = functools.partial(_dq_kernel, **common)
+    else:
+        dq_kernel = functools.partial(
+            lambda qr, kr, vr, sr, dor, lr, der, dqr, acc, **kw: _dq_kernel(
+                qr, kr, vr, None, sr, dor, lr, der, dqr, acc, **kw
+            ),
+            **common,
+        )
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(b, h, s // bq, s // bk),
+        in_specs=in_specs,
+        out_specs=q_by_iq,
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_compiler_params(interpret, 3),
         interpret=interpret,
     )(*operands)
 
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, mask, block_q, block_k, interpret, causal):
-    return _flash_forward(q, k, v, mask, block_q, block_k, interpret, causal)
-
-
-def _flash_fwd(q, k, v, mask, block_q, block_k, interpret, causal):
-    return (
-        _flash_forward(q, k, v, mask, block_q, block_k, interpret, causal),
-        (q, k, v, mask),
-    )
-
-
-def _flash_bwd(block_q, block_k, interpret, causal, residuals, g):
-    q, k, v, mask = residuals
-    # recompute-based backward through the XLA blockwise core: same online
-    # softmax, O(S·block) memory, exact gradients — including d(mask), so a
-    # learned additive bias (ALiBi/relative-position style) trains correctly
-    if mask is None:
-        f = lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, None, block_size=block_k, causal=causal
+    # ---- dk/dv (+ per-head dmask): grid iterates q blocks innermost -----
+    q_by_last = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0))
+    kv_by_third = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0))
+    row_by_last = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, ik, iq: (b_, h_, iq, 0))
+    in_specs = [q_by_last, kv_by_third, kv_by_third]
+    operands = [q, k, v]
+    if mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 1, 1, bk), lambda b_, h_, ik, iq: (b_, 0, 0, ik))
         )
-        _, vjp = jax.vjp(f, q, k, v)
-        dq, dk, dv = vjp(g)
-        return dq, dk, dv, None
-    f = lambda q_, k_, v_, m_: blockwise_attention(
-        q_, k_, v_, m_, block_size=block_k, causal=causal
+        operands.append(mask)
+    in_specs += [seed_spec, q_by_last, row_by_last, row_by_last]
+    operands += [seed_arr, g, lse, delta]
+
+    out_shapes = [
+        jax.ShapeDtypeStruct(k.shape, k.dtype),
+        jax.ShapeDtypeStruct(v.shape, v.dtype),
+    ]
+    out_specs = [kv_by_third, kv_by_third]
+    scratch = [
+        pltpu.VMEM((bk, d), jnp.float32),
+        pltpu.VMEM((bk, d), jnp.float32),
+    ]
+    if mask is not None:
+        out_shapes.append(jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, 1, 1, bk), lambda b_, h_, ik, iq: (b_, h_, 0, ik))
+        )
+        scratch.append(pltpu.VMEM((1, bk), jnp.float32))
+        dkv_kernel = functools.partial(_dkv_kernel, **common)
+    else:
+        dkv_kernel = functools.partial(
+            lambda qr, kr, vr, sr, dor, lr, der, dkr, dvr, dka, dva, **kw:
+            _dkv_kernel(qr, kr, vr, None, sr, dor, lr, der, dkr, dvr, None,
+                        dka, dva, None, **kw),
+            **common,
+        )
+    outs = pl.pallas_call(
+        dkv_kernel,
+        out_shape=tuple(out_shapes),
+        grid=(b, h, s // bk, s // bq),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        scratch_shapes=scratch,
+        compiler_params=_compiler_params(interpret, 3),
+        interpret=interpret,
+    )(*operands)
+
+    if mask is not None:
+        dk, dv, dmask_per_head = outs
+        # mask broadcasts [B,1,1,S] → its cotangent sums over heads (and the
+        # per-head rows already summed over q inside the kernel)
+        dmask = jnp.sum(dmask_per_head, axis=1, keepdims=True).astype(mask.dtype)
+        return dq, dk, dv, dmask
+    dk, dv = outs
+    return dq, dk, dv, None
+
+
+# --------------------------------------------------------------------------
+# custom_vjp wiring
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, mask, seed, block_q, block_k, interpret, causal,
+           dropout_rate, bwd_impl):
+    o, _ = _flash_forward(q, k, v, mask, seed, block_q, block_k, interpret,
+                          causal, dropout_rate)
+    return o
+
+
+def _flash_fwd(q, k, v, mask, seed, block_q, block_k, interpret, causal,
+               dropout_rate, bwd_impl):
+    o, lse = _flash_forward(q, k, v, mask, seed, block_q, block_k, interpret,
+                            causal, dropout_rate)
+    return o, (q, k, v, mask, seed, o, lse)
+
+
+def _flash_bwd(block_q, block_k, interpret, causal, dropout_rate, bwd_impl,
+               residuals, g):
+    q, k, v, mask, seed, o, lse = residuals
+    if bwd_impl == "xla":
+        # recompute-based backward through the XLA blockwise core: same
+        # online softmax, O(S·block) memory, exact gradients — cross-check
+        # path and dropout-free fallback
+        if mask is None:
+            f = lambda q_, k_, v_: blockwise_attention(
+                q_, k_, v_, None, block_size=block_k, causal=causal
+            )
+            _, vjp = jax.vjp(f, q, k, v)
+            dq, dk, dv = vjp(g)
+            return dq, dk, dv, None, None
+        f = lambda q_, k_, v_, m_: blockwise_attention(
+            q_, k_, v_, m_, block_size=block_k, causal=causal
+        )
+        _, vjp = jax.vjp(f, q, k, v, mask)
+        dq, dk, dv, dmask = vjp(g)
+        return dq, dk, dv, dmask, None
+    dq, dk, dv, dmask = _flash_backward(
+        q, k, v, mask, seed, o, lse, g, block_q, block_k, interpret, causal,
+        dropout_rate,
     )
-    _, vjp = jax.vjp(f, q, k, v, mask)
-    return vjp(g)
+    return dq, dk, dv, dmask, None  # None: the integer seed has no tangent
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# --------------------------------------------------------------------------
+# Public API
+# --------------------------------------------------------------------------
 
 
 def flash_attention(
@@ -197,10 +601,13 @@ def flash_attention(
     mask=None,
     dropout_fn=None,
     *,
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
     causal: bool = False,
+    bwd_impl: str = "pallas",
 ):
     """Fused attention: drop-in for ``models.bert.dense_attention``.
 
@@ -208,17 +615,46 @@ def flash_attention(
     [B, 1, 1, S] or None. ``causal=True`` applies the autoregressive
     triangle inside the kernel (above-diagonal key blocks are skipped
     entirely — never build a dense [S,S] causal mask for this kernel).
-    Differentiable (custom VJP). ``interpret=None`` auto-selects
-    interpreter mode off-TPU.
+    Differentiable (custom VJP; ``bwd_impl="pallas"`` = the hand-scheduled
+    dq and dk/dv kernels, ``"xla"`` = the blockwise-core cross-check).
+    ``interpret=None`` auto-selects interpreter mode off-TPU.
+
+    Attention dropout (the reference BERT's ``attention_probs_dropout_prob``,
+    0.1 in the flagship fine-tune) runs in-kernel: pass ``dropout_rate`` and
+    ``dropout_rng`` (a JAX PRNG key, folded to the kernels' hash seed).
+    ``dropout_fn`` — the materialized-probabilities closure the dense core
+    takes — cannot apply here and is rejected; models detect
+    ``flash_attention.inkernel_dropout`` and pass rate+rng instead.
     """
     if dropout_fn is not None:
         raise NotImplementedError(
             "flash_attention never materializes attention probabilities; "
-            "set attention_dropout=0.0"
+            "pass dropout_rate=/dropout_rng= for in-kernel dropout instead "
+            "of a dropout_fn closure"
         )
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_rate > 0 requires dropout_rng")
+        if bwd_impl == "xla":
+            raise NotImplementedError(
+                "the XLA blockwise backward has no in-kernel dropout; use "
+                "bwd_impl='pallas' with dropout_rate > 0"
+            )
+        seed = jax.random.bits(dropout_rng, dtype=jnp.uint32)
+    else:
+        seed = jnp.uint32(0)
+    if bwd_impl not in ("pallas", "xla"):
+        raise ValueError(f"bwd_impl must be 'pallas' or 'xla', got {bwd_impl!r}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, mask, block_q, block_k, interpret, causal)
+    return _flash(q, k, v, mask, seed, block_q, block_k, interpret, causal,
+                  dropout_rate, bwd_impl)
+
+
+# models pass dropout_rate/dropout_rng instead of a dropout_fn closure
+flash_attention.inkernel_dropout = True
 
 
 def causal_flash_attention(q, k, v, mask=None, dropout_fn=None, **kw):
@@ -230,3 +666,4 @@ def causal_flash_attention(q, k, v, mask=None, dropout_fn=None, **kw):
 
 
 causal_flash_attention.handles_causality = True
+causal_flash_attention.inkernel_dropout = True
