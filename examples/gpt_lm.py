@@ -56,6 +56,11 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if min(args.dp, args.tp) < 1:
         parser.error("--dp/--tp must be >= 1")
+    if args.flash and args.tp > 1:
+        # same hazard as bert_finetune: the Pallas kernel is not
+        # GSPMD-partitionable, so --tp's jit path would fail at compile (or
+        # silently replicate) on a real mesh
+        parser.error("--flash cannot run on the GSPMD --tp path; drop --flash")
     if args.zero1 and args.dp < 2:
         # validate BEFORE prepare_model_dir wipes the run directory
         parser.error("--zero1 needs --dp >= 2 (moments shard over 'data')")
@@ -67,7 +72,7 @@ def main(argv=None):
     import numpy as np
 
     import gradaccum_tpu as gt
-    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle, greedy_generate
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
 
     model_dir = prepare_model_dir(args, "gpt_lm")
     if args.text_file:
@@ -98,9 +103,6 @@ def main(argv=None):
         bundle = gpt_lm_bundle(cfg, attention_fn=causal_flash_attention)
     else:
         bundle = gpt_lm_bundle(cfg)
-    # decode lengths vary token by token; the flash kernel needs block
-    # multiples, so sampling always runs the dense core (same params)
-    sample_bundle = gpt_lm_bundle(cfg) if args.flash else bundle
 
     mesh, rules = None, None
     n_mesh = args.dp * args.tp
@@ -156,11 +158,23 @@ def main(argv=None):
     print(f"gpt_lm: next-token accuracy {results['token_accuracy']:.4f}")
 
     if args.sample > 0:
+        import time
+
+        from gradaccum_tpu.models.gpt_decode import generate_cached
+
         prompt = train[0][: S // 2]
-        out = greedy_generate(state.params, sample_bundle, prompt,
-                              num_steps=args.sample)
+        # KV-cache decode: prefill once, O(S) per token (gpt_decode.py);
+        # parity with the recompute greedy_generate is pinned in test_gpt.py
+        out = generate_cached(state.params, cfg, prompt, args.sample)
+        out.block_until_ready()
+        t0 = time.perf_counter()
+        out = generate_cached(state.params, cfg, prompt, args.sample)
+        out.block_until_ready()
+        dt = time.perf_counter() - t0
         txt = bytes(int(t) for t in np.asarray(out[0])).decode("utf-8", "replace")
         print(f"sample: {txt!r}")
+        print(f"decode: {args.sample / dt:.1f} tokens/sec "
+              f"(KV-cache, prefill {len(prompt)} + {args.sample} steps)")
     if args.export_dir:
         blob = est.export_model(args.export_dir,
                                 {"input_ids": evald[:1]}, state=state)

@@ -187,3 +187,90 @@ def test_temperature_sampling(rng):
     assert not np.array_equal(np.asarray(s1), np.asarray(s2))
     with pytest.raises(ValueError, match="rng"):
         greedy_generate(params, bundle, prompt, 2, temperature=1.0)
+
+
+# -- KV-cache decode ----------------------------------------------------------
+
+
+def test_cached_decode_matches_recompute_greedy(rng):
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle, greedy_generate
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    prompt = rng.integers(0, cfg.vocab_size, size=(3, 8)).astype(np.int32)
+    params = bundle.init(jax.random.PRNGKey(0), {"input_ids": prompt})
+
+    got = generate_cached(params, cfg, prompt, 10)
+    want = greedy_generate(params, bundle, prompt, 10)
+    assert got.shape == want.shape == (3, 18)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_cached_decode_temperature_matches_recompute(rng):
+    """Same fold_in(rng, i) seeding scheme => identical samples."""
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle, greedy_generate
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    prompt = rng.integers(0, cfg.vocab_size, size=(2, 6)).astype(np.int32)
+    params = bundle.init(jax.random.PRNGKey(0), {"input_ids": prompt})
+
+    key = jax.random.PRNGKey(11)
+    got = generate_cached(params, cfg, prompt, 8, temperature=0.7, rng=key)
+    want = greedy_generate(params, bundle, prompt, 8, temperature=0.7, rng=key)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_prefill_logits_match_model(rng):
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import prefill
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    prompt = rng.integers(0, cfg.vocab_size, size=(2, 12)).astype(np.int32)
+    params = bundle.init(jax.random.PRNGKey(0), {"input_ids": prompt})
+
+    _, logits = prefill(params, cfg, jnp.asarray(prompt), 16)
+    want = bundle.predict(params, {"input_ids": prompt})["logits"][:, -1]
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_decode_step_positions_and_cache_growth(rng):
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import decode_step, prefill
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    prompt = rng.integers(0, cfg.vocab_size, size=(2, 5)).astype(np.int32)
+    params = bundle.init(jax.random.PRNGKey(0), {"input_ids": prompt})
+
+    cache, logits = prefill(params, cfg, jnp.asarray(prompt), 8)
+    assert int(cache.length) == 5
+    tok = jnp.argmax(logits, axis=-1)
+    cache, step_logits = decode_step(params, cfg, cache, tok)
+    assert int(cache.length) == 6
+    # the cached step must equal the full model run on the extended sequence
+    ext = jnp.concatenate([jnp.asarray(prompt), tok[:, None]], axis=1)
+    want = bundle.predict(params, {"input_ids": ext})["logits"][:, -1]
+    np.testing.assert_allclose(np.asarray(step_logits), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_generate_cached_validation(rng):
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import generate_cached, init_cache
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    prompt = rng.integers(0, cfg.vocab_size, size=(1, 4)).astype(np.int32)
+    params = bundle.init(jax.random.PRNGKey(0), {"input_ids": prompt})
+
+    with pytest.raises(ValueError, match="exceed max_len"):
+        generate_cached(params, cfg, prompt, 8, max_len=6)
+    with pytest.raises(ValueError, match="temperature sampling"):
+        generate_cached(params, cfg, prompt, 4, temperature=0.5)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        init_cache(cfg, 1, cfg.max_position_embeddings + 1)
