@@ -76,18 +76,23 @@ def measure(iters, warmup, unrolls, tune_iters):
 
     steps = {}
 
+    sparse_embed = os.environ.get("GRADACCUM_SPARSE_EMBED", "0") == "1"
+
     def build_step(unroll):
         if unroll not in steps:  # keep the jitted fn so the winner's full-length
-            steps[unroll] = jax.jit(  # pass reuses the tune loop's compile
-                gt.accumulate_scan(
-                    bundle.loss,
-                    opt,
-                    gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0,
-                                       unroll=unroll),
-                    needs_rng=True,
-                ),
-                donate_argnums=0,
-            )
+            cfg_a = gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0,
+                                       unroll=unroll)  # pass reuses the compile
+            if sparse_embed:
+                from gradaccum_tpu.ops.sparse_embed import (
+                    accumulate_scan_sparse_embed,
+                )
+
+                inner = accumulate_scan_sparse_embed(bundle.sparse_embed,
+                                                     opt, cfg_a)
+            else:
+                inner = gt.accumulate_scan(bundle.loss, opt, cfg_a,
+                                           needs_rng=True)
+            steps[unroll] = jax.jit(inner, donate_argnums=0)
         return steps[unroll]
 
     def timed_pass(unroll, n, state):
@@ -131,6 +136,7 @@ def measure(iters, warmup, unrolls, tune_iters):
         "flops_per_seq": flops_per_seq,
         "device": f"{dev.device_kind} ({dev.platform}) x{jax.device_count()}",
         "unroll": unroll,
+        "sparse_embed": sparse_embed,
     }
     if tune_report:
         result["unroll_tune_seq_s"] = tune_report

@@ -168,6 +168,22 @@ def main(argv=None):
              "accumulation — the reference's Loss_Step.png baseline arm)",
     )
     parser.add_argument(
+        "--sparse-embed-grad", action="store_true",
+        help="accumulate the word-embedding gradient as token-level rows "
+             "(ops/sparse_embed.py): one scatter-add per K-cycle instead of "
+             "a dense [vocab, hidden] cotangent per micro-batch; exact "
+             "parity with the dense path. Requires --mode scan",
+    )
+    parser.add_argument(
+        "--train-size", type=int, default=None,
+        help="override the task's synthetic corpus size. Size it to >= "
+             "max_steps x micro-batch so training is a FRESH single-epoch "
+             "stream: a small reusable corpus lets the K=1 arm memorize the "
+             "label noise instead of flooring at its entropy, which hides "
+             "the reference's 'K=4 tighter at the same floor' claim "
+             "(Loss_Step.png, README.md:78)",
+    )
+    parser.add_argument(
         "--label-noise", type=float, default=0.0,
         help="flip this fraction of TRAIN labels (deterministic). Keeps the "
              "loss floored above zero so per-batch gradient noise is visible "
@@ -201,6 +217,12 @@ def main(argv=None):
         parser.error("--zero1 needs --dp >= 2 (moments shard over 'data')")
     if args.zero1 and (args.sp > 1 or args.pp > 1):
         parser.error("--zero1 runs on the GSPMD path (no --sp/--pp)")
+    if args.sparse_embed_grad:
+        if args.mode != "scan":
+            parser.error("--sparse-embed-grad requires --mode scan")
+        if args.sp > 1 or args.pp > 1:
+            parser.error("--sparse-embed-grad composes with scan/dp/tp/ep, "
+                         "not --sp/--pp")
 
     import jax.numpy as jnp
     import numpy as np
@@ -216,7 +238,8 @@ def main(argv=None):
         train_texts, train_labels = load_tsv(f"{args.data_dir}/train.tsv")
         eval_texts, eval_labels = load_tsv(f"{args.data_dir}/dev.tsv")
     else:
-        train_texts, train_labels = synthetic_text_task(t["num_train"], seed=1)
+        n_train = args.train_size or t["num_train"]
+        train_texts, train_labels = synthetic_text_task(n_train, seed=1)
         eval_texts, eval_labels = synthetic_text_task(t["num_eval"], seed=2)
     if args.label_noise > 0:
         flip_rng = np.random.default_rng(19830610)
@@ -408,6 +431,7 @@ def main(argv=None):
         eval_model=eval_bundle,
         pipeline=pipeline,
         zero1=args.zero1,
+        sparse_embed=args.sparse_embed_grad,
     )
 
     # per-device micro-batch × data-parallel width (mnist 03/04 semantics:

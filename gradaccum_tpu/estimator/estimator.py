@@ -47,6 +47,10 @@ class ModelBundle(NamedTuple):
     # SURVEY §1 model layer): stripped when an eval batch is used as the
     # default serving signature so exports don't require label inputs
     label_keys: tuple = ("label",)
+    # optional ops.sparse_embed.SparseEmbedHooks: lets the scan-mode
+    # accumulator carry token-level embedding cotangents instead of a dense
+    # [vocab, hidden] gradient per micro-batch
+    sparse_embed: Any = None
 
 
 class Estimator:
@@ -72,6 +76,7 @@ class Estimator:
         eval_model: Optional[ModelBundle] = None,
         pipeline=None,
         zero1: bool = False,
+        sparse_embed: bool = False,
     ):
         """``warm_start``: a params pytree used instead of ``model.init`` for
         fresh runs (tf.estimator's WarmStartSettings slot — how pretrained
@@ -105,7 +110,14 @@ class Estimator:
         axis (:mod:`parallel.zero` — per-device optimizer memory drops by
         the data width; params stay replicated/rule-sharded, with the step
         jitted under pinned in/out shardings so XLA cannot silently
-        propagate the split into parameter storage)."""
+        propagate the split into parameter storage).
+
+        ``sparse_embed``: accumulate the embedding table's gradient as
+        token-level rows instead of a dense [vocab, hidden] array per
+        micro-batch (:mod:`ops.sparse_embed`; exact parity with the dense
+        path). Requires ``mode='scan'`` and a model exposing
+        ``ModelBundle.sparse_embed`` hooks; composes with the no-mesh, DP,
+        GSPMD-rules, and zero1 paths."""
         if mode not in ("streaming", "scan"):
             raise ValueError(f"mode must be 'streaming' or 'scan', got {mode!r}")
         if sharding_rules is not None and mesh is None:
@@ -140,6 +152,19 @@ class Estimator:
                 raise ValueError(
                     "zero1 runs on the GSPMD path (no 'seq' axis / pipeline)"
                 )
+        if sparse_embed:
+            if mode != "scan":
+                raise ValueError("sparse_embed requires mode='scan'")
+            if model.sparse_embed is None:
+                raise ValueError(
+                    "sparse_embed requires a model with ModelBundle."
+                    "sparse_embed hooks (see models/bert.py)"
+                )
+            if self._sp_active or pipeline is not None:
+                raise ValueError(
+                    "sparse_embed composes with the scan/DP/GSPMD paths, "
+                    "not 'seq' axis or pipeline"
+                )
         self.model = model
         self.optimizer = optimizer
         self.accum = accum
@@ -151,6 +176,7 @@ class Estimator:
         self.eval_model = eval_model if eval_model is not None else model
         self.pipeline = pipeline
         self.zero1 = zero1
+        self.sparse_embed = sparse_embed
         self._train_step = None
         self._eval_step = None
         self._predict_fn = None
@@ -282,9 +308,19 @@ class Estimator:
                 needs_rng=needs_rng,
             )
         elif self.mesh is not None and self.sharding_rules is None and not self.zero1:
+            inner_builder = None
+            if self.sparse_embed:
+                from gradaccum_tpu.ops.sparse_embed import (
+                    accumulate_scan_sparse_embed,
+                )
+
+                inner_builder = lambda cfg: accumulate_scan_sparse_embed(
+                    self.model.sparse_embed, self.optimizer, cfg
+                )
             step = make_dp_train_step(
                 loss_fn, self.optimizer, self.accum, self.mesh,
                 mode=self.mode, needs_rng=needs_rng,
+                inner_builder=inner_builder,
             )
         else:
             # Single jit covers the no-mesh case and the GSPMD paths: with
@@ -295,11 +331,21 @@ class Estimator:
             # zero1 additionally PINS in/out shardings — without them XLA
             # would propagate the moment split into parameter storage
             # (correct numerics, undeclared layout).
-            builder = (
-                acc.accumulate_scan if self.mode == "scan" else acc.streaming_step
-            )
-            inner = builder(loss_fn, self.optimizer, self.accum,
-                            needs_rng=needs_rng)
+            if self.sparse_embed:
+                from gradaccum_tpu.ops.sparse_embed import (
+                    accumulate_scan_sparse_embed,
+                )
+
+                inner = accumulate_scan_sparse_embed(
+                    self.model.sparse_embed, self.optimizer, self.accum
+                )
+            else:
+                builder = (
+                    acc.accumulate_scan if self.mode == "scan"
+                    else acc.streaming_step
+                )
+                inner = builder(loss_fn, self.optimizer, self.accum,
+                                needs_rng=needs_rng)
             jit_kwargs = {}
             if self.zero1:
                 from gradaccum_tpu.parallel.sharding import (

@@ -194,7 +194,7 @@ class BertEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, input_mask=None, segment_ids=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, word_rows=None):
         cfg = self.config
         B, S = input_ids.shape
         if input_mask is None:
@@ -207,8 +207,16 @@ class BertEncoder(nn.Module):
             # local block of a seq-sharded sequence: global positions
             positions = positions + jax.lax.axis_index(self.seq_axis) * S
 
-        word = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
-                        name="word_embeddings")(input_ids)
+        word_embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                              name="word_embeddings")
+        if word_rows is None:
+            word = word_embed(input_ids)
+        else:
+            # pre-gathered [B, S, hidden] word rows: the sparse
+            # embedding-gradient path (ops/sparse_embed.py) differentiates
+            # w.r.t. these rows and scatter-adds ONE dense table gradient at
+            # apply time, instead of a dense [V, H] cotangent per micro-batch
+            word = word_rows.astype(cfg.dtype)
         pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
                        dtype=cfg.dtype, name="position_embeddings")(positions)
         typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
@@ -248,10 +256,10 @@ class BertClassifier(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, input_mask=None, segment_ids=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, word_rows=None):
         cfg = self.config
         seq = BertEncoder(cfg, self.attention_fn, self.seq_axis, name="bert")(
-            input_ids, input_mask, segment_ids, deterministic
+            input_ids, input_mask, segment_ids, deterministic, word_rows
         )
         cls = seq[:, 0]  # [CLS] (with seq_axis: local token 0 of this block)
         if self.seq_axis is not None:
@@ -314,12 +322,13 @@ def bert_classifier_bundle(
 
     moe = config.num_experts > 0
 
-    def _apply(params, batch, deterministic, rngs=None):
+    def _apply(params, batch, deterministic, rngs=None, word_rows=None):
         args = (
             batch["input_ids"],
             batch.get("input_mask"),
             batch.get("segment_ids"),
             deterministic,
+            word_rows,
         )
         if not moe:
             return model.apply(params, *args, rngs=rngs), 0.0
@@ -332,12 +341,7 @@ def bert_classifier_bundle(
         return logits, aux
 
     def loss(params, batch):
-        logits, moe_aux = _apply(
-            params, batch, False, rngs={"dropout": batch["rng"]}
-        )
-        onehot = jax.nn.one_hot(batch["label"], num_classes)
-        ce = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
-        return ce + config.moe_aux_weight * moe_aux
+        return loss_with_rows(params, None, batch)
 
     def predict(params, batch):
         logits, _ = _apply(params, batch, True)
@@ -347,10 +351,30 @@ def bert_classifier_bundle(
             "probabilities": jax.nn.softmax(logits),
         }
 
+    def loss_with_rows(params, word_rows, batch):
+        """``loss`` with the word-embedding rows as an explicit argument —
+        the word table itself goes unused, so its cotangent is zero and the
+        caller reconstructs it from d(loss)/d(word_rows) by scatter-add
+        (ops/sparse_embed.py)."""
+        logits, moe_aux = _apply(
+            params, batch, False, rngs={"dropout": batch["rng"]},
+            word_rows=word_rows,
+        )
+        onehot = jax.nn.one_hot(batch["label"], num_classes)
+        ce = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+        return ce + config.moe_aux_weight * moe_aux
+
+    from gradaccum_tpu.ops.sparse_embed import SparseEmbedHooks
+
     return ModelBundle(
         init=init,
         loss=loss,
         predict=predict,
         eval_metrics={"accuracy": accuracy()},
         needs_rng=True,
+        sparse_embed=SparseEmbedHooks(
+            table_path=("params", "bert", "word_embeddings", "embedding"),
+            ids_key="input_ids",
+            loss_with_rows=loss_with_rows,
+        ),
     )

@@ -72,25 +72,26 @@ def _hash_u32(x):
     return x ^ (x >> jnp.uint32(16))
 
 
-def _keep_from_counter(counter, seed, keep_threshold):
-    """counter: uint32 flat element index; seed: traced uint32 scalar."""
-    return _hash_u32(counter + seed * jnp.uint32(_GOLDEN)) < keep_threshold
+def _keep_from_counter(counter, bh, seed, keep_threshold):
+    """``counter``: uint32 position index within one (batch, head) slice —
+    q·S + k, which stays collision-free for S < 2¹⁶; ``bh``: the (batch,
+    head) slice index, folded into a DERIVED per-slice seed rather than the
+    counter, so distinct slices get independent streams with no 2³²
+    flat-index wraparound (B·H·S² can exceed 2³² at long context)."""
+    slice_seed = _hash_u32(seed + bh * jnp.uint32(_GOLDEN))
+    return _hash_u32(counter + slice_seed * jnp.uint32(_GOLDEN)) < keep_threshold
 
 
 def _tile_keep(b, h, iq_start, ik_start, bq, bk, *, num_heads, seq, seed,
                keep_threshold):
-    """[bq, bk] keep mask for the tile at (b, h, iq_start, ik_start).
-
-    The flat counter ((b·H + h)·S + qpos)·S + kpos wraps mod 2³² — fine, the
-    hash only needs distinct counters to stay distinct, and the SAME formula
-    runs in the forward kernel, both backward kernels, and
-    :func:`dropout_keep_mask`.
-    """
+    """[bq, bk] keep mask for the tile at (b, h, iq_start, ik_start). The
+    SAME formula runs in the forward kernel, both backward kernels, and
+    :func:`dropout_keep_mask`."""
     q_pos = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0) + jnp.uint32(iq_start)
     k_pos = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1) + jnp.uint32(ik_start)
-    base = (jnp.uint32(b) * jnp.uint32(num_heads) + jnp.uint32(h))
-    counter = (base * jnp.uint32(seq) + q_pos) * jnp.uint32(seq) + k_pos
-    return _keep_from_counter(counter, seed, keep_threshold)
+    bh = jnp.uint32(b) * jnp.uint32(num_heads) + jnp.uint32(h)
+    counter = q_pos * jnp.uint32(seq) + k_pos
+    return _keep_from_counter(counter, bh, seed, keep_threshold)
 
 
 def dropout_keep_mask(seed, batch, num_heads, seq, rate):
@@ -98,14 +99,15 @@ def dropout_keep_mask(seed, batch, num_heads, seq, rate):
     tests: apply it to a dense reference and the kernel path must match
     EXACTLY (same decisions), not just in expectation."""
     keep_threshold, _ = _dropout_config(rate)
-    b = jax.lax.broadcasted_iota(jnp.uint32, (batch, num_heads, seq, seq), 0)
-    h = jax.lax.broadcasted_iota(jnp.uint32, (batch, num_heads, seq, seq), 1)
-    qp = jax.lax.broadcasted_iota(jnp.uint32, (batch, num_heads, seq, seq), 2)
-    kp = jax.lax.broadcasted_iota(jnp.uint32, (batch, num_heads, seq, seq), 3)
-    counter = ((b * jnp.uint32(num_heads) + h) * jnp.uint32(seq) + qp) * jnp.uint32(
-        seq
-    ) + kp
-    return _keep_from_counter(counter, jnp.asarray(seed, jnp.uint32), keep_threshold)
+    shape = (batch, num_heads, seq, seq)
+    b = jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+    h = jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    qp = jax.lax.broadcasted_iota(jnp.uint32, shape, 2)
+    kp = jax.lax.broadcasted_iota(jnp.uint32, shape, 3)
+    bh = b * jnp.uint32(num_heads) + h
+    counter = qp * jnp.uint32(seq) + kp
+    return _keep_from_counter(counter, bh, jnp.asarray(seed, jnp.uint32),
+                              keep_threshold)
 
 
 def _dropout_config(dropout_rate):

@@ -1,0 +1,139 @@
+"""Sparse (token-level) embedding-gradient accumulation.
+
+The round-2 MFU analysis named the residual: under scan-mode accumulation
+the word-embedding table's gradient is a dense [vocab, hidden] array whose
+f32 accumulator round-trips HBM on every one of the K micro-batches — for
+BERT-Small that is 30522×512×4 B ≈ 60 MB read+written K times, while the
+information content is only the [micro, seq, hidden] rows the batch's token
+ids actually touched (8×128×512×4 B ≈ 2 MB).
+
+This transform exploits that token ids are integers: the model exposes its
+loss with the gathered word rows as an EXPLICIT argument
+(``ModelBundle.sparse_embed.loss_with_rows``, e.g. models/bert.py), so the
+scan differentiates w.r.t. the rows — [K, micro, seq, hidden] stacked scan
+outputs, no dense table cotangent anywhere in the loop — and ONE
+``scatter-add`` builds the dense gradient at apply time. Mathematically
+identical to the dense path (the scatter-add IS the gather's transpose;
+summing row cotangents before scattering == summing dense scatters), so
+normalize → clip → AdamW proceed unchanged and parity is exact up to f32
+summation order (tests/test_sparse_embed.py).
+
+AdamW itself stays dense over the table — with the reference's semantics
+(optimization.py:151-176) zero-gradient rows still decay moments and apply
+weight decay, so a rows-only optimizer update would NOT be equivalent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gradaccum_tpu.ops.accumulation import (
+    GradAccumConfig,
+    ScanState,
+    _finalize,
+    _with_rng,
+)
+from gradaccum_tpu.ops.adamw import Optimizer
+from gradaccum_tpu.utils.tree import tree_zeros_like
+
+
+class SparseEmbedHooks(NamedTuple):
+    """What a model must expose for the sparse embedding-grad path."""
+
+    table_path: Sequence[str]  # path into the params pytree to the [V,H] table
+    ids_key: str  # batch key holding the [micro, seq] int token ids
+    loss_with_rows: Callable  # (params, word_rows, batch) -> scalar loss
+
+
+def _get_path(tree, path):
+    for p in path:
+        tree = tree[p]
+    return tree
+
+
+def _set_path(tree, path, value):
+    if not path:
+        return value
+    return dict(tree, **{path[0]: _set_path(tree[path[0]], path[1:], value)})
+
+
+def accumulate_scan_sparse_embed(
+    hooks: SparseEmbedHooks,
+    optimizer: Optimizer,
+    config: GradAccumConfig,
+) -> Callable[..., tuple]:
+    """Scan-mode train step (drop-in for ``accumulate_scan`` with
+    ``needs_rng=True``) whose embedding-table gradient accumulates as
+    token-level rows. Signature: ``train_step(state, super_batch, rng)``.
+
+    Supports ``config.axis_name`` (data parallelism): the one psum at apply
+    time covers the scattered table gradient along with everything else.
+    """
+    k = config.num_micro_batches
+    grad_fn = jax.value_and_grad(hooks.loss_with_rows, argnums=(0, 1))
+    axis = config.axis_name
+
+    def train_step(state: ScanState, super_batch, rng=None):
+        leading = {x.shape[0] for x in jax.tree.leaves(super_batch)}
+        if leading != {k}:
+            raise ValueError(
+                f"super_batch leaves must be stacked [K={k}, micro, ...]; got "
+                f"leading dims {sorted(leading)}. Use stack_micro_batches(batch, K)."
+            )
+        if rng is None:
+            raise ValueError("pass train_step(state, batch, rng)")
+
+        diff_params = (
+            jax.tree.map(lambda p: lax.pcast(p, axis, to="varying"), state.params)
+            if axis is not None
+            else state.params
+        )
+        table = _get_path(diff_params, hooks.table_path)
+        xs = (super_batch, jax.random.split(rng, k))
+
+        def body(accum, x):
+            micro_batch, key = x
+            micro_batch = _with_rng(micro_batch, key)
+            # gather OUTSIDE the differentiated function: d(loss)/d(table)
+            # flows through the rows argument only
+            rows = jnp.take(table, micro_batch[hooks.ids_key], axis=0)
+            loss, (g_params, g_rows) = grad_fn(diff_params, rows, micro_batch)
+            accum = jax.tree.map(jnp.add, accum, g_params)
+            return accum, (loss, g_rows)
+
+        accum0 = tree_zeros_like(diff_params)
+        accum, (losses, rows_ct) = lax.scan(body, accum0, xs, length=k,
+                                            unroll=config.unroll)
+        # ONE dense scatter-add for the whole K-cycle: rows_ct is
+        # [K, micro, seq, hidden], ids [K, micro, seq]
+        ids = super_batch[hooks.ids_key].reshape(-1)
+        table_grad = jnp.zeros_like(table).at[ids].add(
+            rows_ct.reshape(-1, rows_ct.shape[-1]).astype(table.dtype)
+        )
+        # the table's in-tree cotangent is zero (the split loss never reads
+        # it), so placing the scattered gradient there completes the sum
+        accum = _set_path(accum, tuple(hooks.table_path), table_grad)
+
+        if axis is not None:
+            accum = lax.psum(accum, axis)
+            denom = k * lax.axis_size(axis)
+        else:
+            denom = k
+        grads, norm = _finalize(accum, config, denom)
+        apply_step = state.step + k
+        new_params, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params, apply_step
+        )
+        new_state = ScanState(
+            params=new_params, opt_state=new_opt_state, step=apply_step
+        )
+        loss = jnp.mean(losses)
+        if axis is not None:
+            loss = lax.pmean(loss, axis)
+        return new_state, {"loss": loss, "grad_norm": norm, "lr_step": apply_step}
+
+    return train_step

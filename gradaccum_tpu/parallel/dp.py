@@ -41,6 +41,7 @@ def make_dp_train_step(
     mode: str = "scan",
     axis: str = DATA_AXIS,
     needs_rng: bool = False,
+    inner_builder=None,
 ):
     """Explicit-collective DP step via shard_map. See module docstring.
 
@@ -49,10 +50,20 @@ def make_dp_train_step(
     (every replica derives the same per-micro-batch dropout keys — batches
     differ per replica, so noise decorrelates through the data, matching the
     reference where each worker owns its own graph-level random ops).
+
+    ``inner_builder(config) -> train_step`` (scan mode only) swaps the inner
+    accumulator, e.g. ``ops.sparse_embed.accumulate_scan_sparse_embed`` —
+    it receives the axis-bound config and must psum on ``config.axis_name``.
     """
     config = config._replace(axis_name=axis)
+    if inner_builder is not None and mode != "scan":
+        raise ValueError("inner_builder requires mode='scan'")
     if mode == "scan":
-        inner = acc.accumulate_scan(loss_fn, optimizer, config, needs_rng=needs_rng)
+        if inner_builder is not None:
+            inner = inner_builder(config)
+        else:
+            inner = acc.accumulate_scan(loss_fn, optimizer, config,
+                                        needs_rng=needs_rng)
         batch_spec = P(None, axis)  # [K, B, ...]: shard the micro-batch dim
         # scan mode already pmeans its aux loss; everything else is invariant
         step = inner
