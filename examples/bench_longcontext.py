@@ -79,7 +79,9 @@ def measure_one(seq, core, remat, iters, tokens_per_step=TOKENS_PER_STEP):
     from gradaccum_tpu.utils.timing import time_device_steps
 
     per_step, state = time_device_steps(step, state, (stacked, key), iters)
+    dev = jax.devices()[0]
     return {
+        "device": f"{dev.device_kind} ({dev.platform})",
         "seq": seq,
         "core": core,
         "remat": remat,
@@ -120,7 +122,7 @@ def main(argv=None):
                 try:
                     row = measure_one(seq, core, remat, args.iters, args.tokens)
                 except Exception as e:  # OOM at long dense lengths is data
-                    row = {"seq": seq, "core": core, "remat": remat,
+                    row = {"device": None, "seq": seq, "core": core, "remat": remat,
                            "micro_batch": max(1, args.tokens // seq),
                            "ms_per_step": None, "tokens_per_sec": None,
                            "error": type(e).__name__}
@@ -131,7 +133,7 @@ def main(argv=None):
 
     out = Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
-    fields = ["seq", "core", "remat", "micro_batch", "ms_per_step",
+    fields = ["device", "seq", "core", "remat", "micro_batch", "ms_per_step",
               "tokens_per_sec", "error"]
     with open(out, "w", newline="") as f:
         w = csv.DictWriter(f, fieldnames=fields)
