@@ -17,6 +17,8 @@ from gradaccum_tpu.models.moe import moe_ep_rules
 from gradaccum_tpu.parallel.mesh import make_mesh
 from gradaccum_tpu.parallel.tp import bert_tp_ep_rules, bert_tp_rules
 
+pytestmark = pytest.mark.slow  # every case trains N steps on the 8-device mesh
+
 K = 2
 MICRO = 8  # divisible by the data axis in every mesh below
 SEQ = 16

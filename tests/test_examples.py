@@ -77,6 +77,7 @@ def test_housing_entrypoint_smoke(tmp_path):
     assert "rmse" in res
 
 
+@pytest.mark.slow
 def test_bert_entrypoint_smoke(tmp_path):
     res = _run_example("bert_finetune", [
         "--task", "cola", "--accum-k", "2", "--max-steps", "4",
@@ -85,6 +86,7 @@ def test_bert_entrypoint_smoke(tmp_path):
     assert 0.0 <= res["accuracy"] <= 1.0
 
 
+@pytest.mark.slow
 def test_bert_entrypoint_dp_tp_mesh_smoke(tmp_path):
     """--dp/--tp flags build a (data, model) mesh and train through the
     Estimator's sharding_rules path (numerics pinned by test_estimator_rules)."""
@@ -96,6 +98,7 @@ def test_bert_entrypoint_dp_tp_mesh_smoke(tmp_path):
     assert 0.0 <= res["accuracy"] <= 1.0
 
 
+@pytest.mark.slow
 def test_bert_entrypoint_sp_mesh_smoke(tmp_path):
     """--sp shards the token dim over a 'seq' axis (ring attention) with the
     dense twin serving eval (numerics pinned by test_estimator_rules)."""
@@ -128,6 +131,7 @@ def test_bert_entrypoint_flag_validation(tmp_path):
                                        "--model-dir", str(tmp_path / "x")])
 
 
+@pytest.mark.slow
 def test_gpt_entrypoint_smoke(tmp_path):
     res = _run_example("gpt_lm", [
         "--max-steps", "8", "--seq-len", "32", "--batch", "8",

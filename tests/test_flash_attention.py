@@ -339,6 +339,7 @@ def test_dropout_validation(rng):
                         dropout_rng=jax.random.PRNGKey(0))
 
 
+@pytest.mark.slow
 def test_bert_flash_trains_with_attention_dropout(rng):
     """The flagship config (attention_dropout=0.1) runs on the flash kernel:
     SelfAttention detects inkernel_dropout and routes rate + rng through."""

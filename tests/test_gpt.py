@@ -47,6 +47,7 @@ def test_causality(rng):
     assert not np.allclose(np.asarray(la[:, -1]), np.asarray(lb[:, -1]))
 
 
+@pytest.mark.slow
 def test_memorizes_sequence_and_generates_it(rng):
     """Overfit one repeated sequence; greedy decode must reproduce it."""
     cfg = GPTConfig.tiny_for_tests(dropout=0.0)
@@ -75,6 +76,7 @@ def test_memorizes_sequence_and_generates_it(rng):
     np.testing.assert_array_equal(np.asarray(out[0]), seq)
 
 
+@pytest.mark.slow
 def test_tp_rules_apply_to_gpt(rng):
     """The BERT tensor-parallel rules shard GPT unchanged (shared naming):
     N training steps on a (data, model) mesh match single-device."""
@@ -121,6 +123,7 @@ def test_tp_rules_apply_to_gpt(rng):
     )
 
 
+@pytest.mark.slow
 def test_estimator_trains_gpt(rng, tmp_path):
     """The full harness applies unchanged: train/eval/export on the LM."""
     from gradaccum_tpu.estimator.export import load_exported
@@ -170,6 +173,7 @@ def test_loss_mask(rng):
     assert abs(float(full) - float(half)) > 1e-6
 
 
+@pytest.mark.slow
 def test_temperature_sampling(rng):
     cfg = GPTConfig.tiny_for_tests(dropout=0.0)
     bundle = gpt_lm_bundle(cfg)
@@ -192,6 +196,7 @@ def test_temperature_sampling(rng):
 # -- KV-cache decode ----------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_cached_decode_matches_recompute_greedy(rng):
     from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle, greedy_generate
     from gradaccum_tpu.models.gpt_decode import generate_cached
@@ -207,6 +212,7 @@ def test_cached_decode_matches_recompute_greedy(rng):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.slow
 def test_cached_decode_temperature_matches_recompute(rng):
     """Same fold_in(rng, i) seeding scheme => identical samples."""
     from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle, greedy_generate

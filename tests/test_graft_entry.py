@@ -2,6 +2,10 @@
 
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full 7-leg dryrun + flagship compile
+
 sys.path.insert(0, "/root/repo")
 
 

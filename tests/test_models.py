@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from gradaccum_tpu.data.mnist import synthetic
 from gradaccum_tpu.data.pipeline import Dataset
@@ -160,6 +161,7 @@ def test_bert_dropout_rng_changes_loss(rng):
     np.testing.assert_array_equal(np.asarray(p1["logits"]), np.asarray(p2["logits"]))
 
 
+@pytest.mark.slow
 def test_bert_trains_on_tiny_task(rng):
     """Sequences of token 7 vs token 9 → labels; BERT must separate them."""
     cfg = BertConfig.tiny_for_tests()

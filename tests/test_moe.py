@@ -116,6 +116,7 @@ def _bert_batch(rng, cfg):
     }
 
 
+@pytest.mark.slow
 def test_moe_bert_bundle_trains_and_predicts(rng):
     """The transformer-with-MoE-FFN ModelBundle works through the standard
     scan-mode train step: loss finite + descending, moe params get grads."""
@@ -151,6 +152,7 @@ def test_moe_bert_bundle_trains_and_predicts(rng):
     assert out["classes"].shape == (K * B,)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("dp,ep", [(2, 4), (4, 2)])
 def test_dp_ep_training_matches_single_device(rng, dp, ep):
     """dp×ep: expert-sharded TrainState + data-sharded batch (GSPMD) must

@@ -38,6 +38,7 @@ def _worker_env():
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     return env
 
+@pytest.mark.slow
 def test_two_process_dp_step():
     port = _free_port()
     env = _worker_env()
@@ -77,6 +78,7 @@ def test_two_process_dp_step():
     assert fields0["w00"] == fields1["w00"]
 
 
+@pytest.mark.slow
 def test_two_process_hybrid_mesh_model_sharding():
     """make_hybrid_mesh across real processes: 'data' (DCN) spans the two
     workers, 'model' (ICI) stays on each worker's local devices, and the

@@ -257,6 +257,7 @@ def _bert_pp_setup(rng, n_stages=2):
     return gt, cfg, bundle, dense_params, batch, fns, parts, K
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("pipe,dp", [(2, 1), (2, 4)])
 def test_bert_pipeline_matches_dense_training(rng, pipe, dp):
     """The flagship model on the GPipe schedule: N train steps of
@@ -338,6 +339,7 @@ def test_bert_pp_rejects_dropout_and_moe(rng):
         )
 
 
+@pytest.mark.slow
 def test_bert_pipeline_remat_matches(rng):
     """cfg.remat in the pipeline stages recomputes activations without
     changing the update."""

@@ -1,0 +1,86 @@
+"""Committed results evidence must be internally consistent.
+
+Round-2 verdict: ``results/summary.json`` had gone stale against the
+committed CSVs after an ``--only`` rerun refreshed one curve but not the
+summary. The generator now derives the summary strictly from the CSVs it
+just wrote (examples/reproduce_results.py); these tests pin that contract
+on the COMMITTED artifacts, so any future desync fails CI instead of
+shipping contradictory evidence.
+"""
+
+import csv
+import json
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+RESULTS = REPO / "results"
+
+from examples.plot_loss import read_curve_file  # noqa: E402
+from examples.reproduce_results import BERT_RUNS, curve_stats  # noqa: E402
+
+
+def _summary():
+    path = RESULTS / "summary.json"
+    if not path.exists():
+        pytest.skip("no committed results/summary.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_summary_matches_committed_csvs():
+    """Every summary entry == curve_stats(its committed CSV), field for
+    field — the summary is a pure function of the evidence."""
+    summary = _summary()
+    assert summary["runs"], "summary has no runs"
+    for name, entry in summary["runs"].items():
+        path = RESULTS / f"{name}.csv"
+        assert path.exists(), f"summary names {name} but {path} is missing"
+        want = curve_stats(*read_curve_file(path))
+        got = {k: entry.get(k) for k in want}
+        assert got == want, (
+            f"{name}: summary {got} != recomputed-from-CSV {want}"
+        )
+
+
+def test_committed_csvs_all_summarized():
+    """No orphan curves: every committed loss CSV appears in the summary."""
+    summary = _summary()
+    for path in RESULTS.glob("*.csv"):
+        if path.stem.startswith("longcontext"):
+            continue  # kernel-scaling artifact, not a loss curve
+        assert path.stem in summary["runs"], (
+            f"{path.name} committed but absent from summary.json"
+        )
+
+
+def test_bert_arms_ran_equal_budgets():
+    """The two BERT arms are x-comparable: same micro-step budget (the
+    round-2 verdict flagged 3,200 vs 1,600), and the config pins a fresh
+    single-epoch corpus so neither arm can memorize the label noise."""
+    summary = _summary()
+    k4 = summary["runs"].get("bert_cola_k4_eff32")
+    k1 = summary["runs"].get("bert_cola_k1_eff8")
+    if not (k4 and k1):
+        pytest.skip("BERT arms not in committed summary")
+    assert k4["steps"] == k1["steps"], (k4["steps"], k1["steps"])
+    # fresh-stream config: corpus >= steps x micro-batch for both arms
+    for _, extra in BERT_RUNS:
+        opts = dict(zip(extra[::2], extra[1::2]))
+        assert int(opts["--train-size"]) >= int(opts["--max-steps"]) * 8
+
+
+def test_bert_noise_floor_not_memorized():
+    """With a fresh-sampled stream, both arms floor at the label-noise
+    entropy — the K=1 arm must NOT drive tail loss to ~0 by memorizing the
+    flips (round-2 verdict, Weak #3). H(0.15) ≈ 0.42, so anything below
+    0.1 means memorization crept back in."""
+    summary = _summary()
+    k1 = summary["runs"].get("bert_cola_k1_eff8")
+    if not k1 or k1.get("quick"):
+        pytest.skip("no full-run K=1 arm committed")
+    assert k1["tail_loss_mean"] > 0.1, (
+        f"K=1 tail loss {k1['tail_loss_mean']} ~ 0: the arm memorized the "
+        "noise; the corpus must be a fresh single-epoch stream"
+    )

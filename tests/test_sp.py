@@ -55,6 +55,7 @@ def _single_device_step(cfg, params, batch, opt):
     return state, aux
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("dp,sp", [(2, 4), (1, 8), (4, 2)])
 def test_dp_sp_step_matches_single_device(rng, dp, sp):
     cfg = _cfg()

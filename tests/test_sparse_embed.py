@@ -40,6 +40,7 @@ def _steps(bundle, opt, accfg):
     return dense, sparse
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("clip", [None, 1.0])
 def test_sparse_matches_dense_step(rng, clip):
     """Same loss, same grad norm, same post-step params — the scatter-add
@@ -64,6 +65,7 @@ def test_sparse_matches_dense_step(rng, clip):
     assert int(ss.step) == K
 
 
+@pytest.mark.slow
 def test_sparse_matches_dense_multi_step(rng):
     """Trajectories stay together over several updates (moments included)."""
     cfg, bundle, batch, params, opt = _setup(rng)
@@ -84,6 +86,7 @@ def test_sparse_matches_dense_multi_step(rng):
     )
 
 
+@pytest.mark.slow
 def test_sparse_repeated_ids_scatter_adds(rng):
     """A batch where every row repeats one token id: the scatter must SUM
     the row cotangents, and untouched vocab rows still receive the AdamW
@@ -107,6 +110,7 @@ def test_sparse_repeated_ids_scatter_adds(rng):
     assert np.abs(dt[10] - t0[10]).max() > 0
 
 
+@pytest.mark.slow
 def test_sparse_with_dp_axis(rng):
     """config.axis_name: the apply-time psum covers the scattered table
     gradient — parity vs the dense DP step on a 4-device mesh."""

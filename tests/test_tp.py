@@ -47,6 +47,7 @@ def _train(step_fn, state, batches, rngs):
     return state, losses
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("dp,tp", [(4, 2), (2, 4), (1, 8)])
 def test_dp_tp_training_matches_single_device(rng, dp, tp):
     cfg = BertConfig.tiny_for_tests()

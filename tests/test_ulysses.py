@@ -41,6 +41,7 @@ def _batch(rng, cfg):
     }
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("dp,sp,heads", [(4, 2, 2), (2, 4, 4), (1, 8, 8)])
 def test_dp_ulysses_step_matches_single_device(rng, dp, sp, heads):
     cfg = _cfg(heads)
