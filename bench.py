@@ -16,9 +16,11 @@ liveness probes across the whole driver window (default 3 h, tunable via
 BENCH_TPU_WAIT_S) and fires the full measurement the moment a probe
 succeeds; the clearly-labeled CPU fallback is the final act only.
 
-On an accelerator the scan `unroll` knob is auto-tuned over {1,2,4}
-(short passes, then a full-length pass on the winner); GRADACCUM_UNROLL
-pins a single value and skips the tune.
+On an accelerator the tune pass races the dense and sparse-embedding-grad
+engines (ops/accumulation.py vs ops/sparse_embed.py) across scan `unroll`
+in {1,2,4} — short passes, then a full-length pass on the winner.
+GRADACCUM_UNROLL pins the unroll; GRADACCUM_SPARSE_EMBED=1/0 pins the
+engine.
 """
 
 import argparse
@@ -76,13 +78,19 @@ def measure(iters, warmup, unrolls, tune_iters):
 
     steps = {}
 
-    sparse_embed = os.environ.get("GRADACCUM_SPARSE_EMBED", "0") == "1"
+    # GRADACCUM_SPARSE_EMBED pins the engine (1 = sparse, 0 = dense); unset
+    # lets the tune pass race both when it runs at all
+    pin = os.environ.get("GRADACCUM_SPARSE_EMBED")
+    engines = ("sparse",) if pin == "1" else (
+        ("dense",) if pin == "0" or len(unrolls) == 1 else ("dense", "sparse")
+    )
 
-    def build_step(unroll):
-        if unroll not in steps:  # keep the jitted fn so the winner's full-length
-            cfg_a = gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0,
-                                       unroll=unroll)  # pass reuses the compile
-            if sparse_embed:
+    def build_step(engine, unroll):
+        if (engine, unroll) not in steps:  # cache jitted fns: the winner's
+            cfg_a = gt.GradAccumConfig(  # full pass reuses its tune compile
+                num_micro_batches=K, clip_norm=1.0, unroll=unroll
+            )
+            if engine == "sparse":
                 from gradaccum_tpu.ops.sparse_embed import (
                     accumulate_scan_sparse_embed,
                 )
@@ -92,11 +100,11 @@ def measure(iters, warmup, unrolls, tune_iters):
             else:
                 inner = gt.accumulate_scan(bundle.loss, opt, cfg_a,
                                            needs_rng=True)
-            steps[unroll] = jax.jit(inner, donate_argnums=0)
-        return steps[unroll]
+            steps[(engine, unroll)] = jax.jit(inner, donate_argnums=0)
+        return steps[(engine, unroll)]
 
-    def timed_pass(unroll, n, state):
-        step = build_step(unroll)
+    def timed_pass(engine, unroll, n, state):
+        step = build_step(engine, unroll)
         for _ in range(max(warmup, 1)):  # >=1: the drain below needs aux bound
             state, aux = step(state, stacked, key)
         float(jax.device_get(aux["loss"]))  # drain warmup
@@ -106,20 +114,22 @@ def measure(iters, warmup, unrolls, tune_iters):
         return per_step, state
 
     tune_report = {}
-    if len(unrolls) > 1:
-        best_unroll, best = None, float("inf")
-        for unroll in unrolls:
-            per_step, state = timed_pass(unroll, tune_iters, state)
-            tune_report[str(unroll)] = round(K * MICRO / per_step, 2)
-            print(f"[bench] tune unroll={unroll}: {tune_report[str(unroll)]} seq/s",
+    candidates = [(e, u) for e in engines for u in unrolls]
+    if len(candidates) > 1:
+        best_cand, best = None, float("inf")
+        for engine, u in candidates:
+            per_step, state = timed_pass(engine, u, tune_iters, state)
+            label = f"{engine}:u{u}"
+            tune_report[label] = round(K * MICRO / per_step, 2)
+            print(f"[bench] tune {label}: {tune_report[label]} seq/s",
                   file=sys.stderr)
             if per_step < best:
-                best_unroll, best = unroll, per_step
-        unroll = best_unroll
+                best_cand, best = (engine, u), per_step
+        engine, unroll = best_cand
     else:
-        unroll = unrolls[0]
+        engine, unroll = candidates[0]
 
-    per_step, state = timed_pass(unroll, iters, state)
+    per_step, state = timed_pass(engine, unroll, iters, state)
 
     seqs_per_sec = K * MICRO / per_step
     flops_per_seq = bert_train_flops_per_seq(
@@ -136,10 +146,10 @@ def measure(iters, warmup, unrolls, tune_iters):
         "flops_per_seq": flops_per_seq,
         "device": f"{dev.device_kind} ({dev.platform}) x{jax.device_count()}",
         "unroll": unroll,
-        "sparse_embed": sparse_embed,
+        "engine": engine,
     }
     if tune_report:
-        result["unroll_tune_seq_s"] = tune_report
+        result["tune_seq_s"] = tune_report
     return result
 
 
