@@ -320,6 +320,13 @@ def main(argv=None):
         # the Pallas kernel is not GSPMD-partitionable: under --tp/--ep's jit
         # path it would fail at compile (or silently replicate) on a real mesh
         parser.error("--flash cannot run on the GSPMD --tp/--ep path; drop --flash")
+    if args.flash and args.dp > 1:
+        from gradaccum_tpu.ops.flash_attention import flash_composes_with_shard_map
+
+        if not flash_composes_with_shard_map():
+            parser.error("--flash --dp needs the compiled TPU kernel; on "
+                         "CPU (interpret mode) run --flash single-device or "
+                         "--dp with the dense core")
     if args.sp > 1:
         if args.flash:
             parser.error("--sp brings its own attention core; drop --flash")

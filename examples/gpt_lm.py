@@ -48,7 +48,7 @@ def main(argv=None):
     parser.add_argument(
         "--flash", action="store_true",
         help="causal Pallas flash attention (kernel-side triangle, "
-             "above-diagonal key blocks skipped; forces dropout=0)",
+             "above-diagonal key blocks skipped, in-kernel dropout)",
     )
     parser.add_argument("--export-dir", default=None)
     parser.add_argument("--sample", type=int, default=40,
@@ -61,6 +61,12 @@ def main(argv=None):
         # GSPMD-partitionable, so --tp's jit path would fail at compile (or
         # silently replicate) on a real mesh
         parser.error("--flash cannot run on the GSPMD --tp path; drop --flash")
+    if args.flash and args.dp > 1:
+        from gradaccum_tpu.ops.flash_attention import flash_composes_with_shard_map
+
+        if not flash_composes_with_shard_map():
+            parser.error("--flash --dp needs the compiled TPU kernel; on "
+                         "CPU run --flash single-device or --dp dense")
     if args.zero1 and args.dp < 2:
         # validate BEFORE prepare_model_dir wipes the run directory
         parser.error("--zero1 needs --dp >= 2 (moments shard over 'data')")

@@ -198,6 +198,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, seed_ref, o_ref, lse_ref,
         lse_ref[0, 0] = m_ref[:] + jnp.log(l_ref[:])
 
 
+
+def _union_vma(*operands):
+    """Union of the operands' varying-manual-axes: every kernel output
+    depends on all of q/k/v/mask, so its vma is their union (stamping from
+    q alone would mis-declare outputs replicated when only k/v vary)."""
+    vma = frozenset()
+    for o in operands:
+        if o is not None:
+            vma = vma | (getattr(jax.typeof(o), "vma", None) or frozenset())
+    return vma
+
+
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct for a pallas_call output, carrying varying-manual-
+    axes so the kernels compose with shard_map (e.g. the DP train step):
+    under check_vma, an output with vma=None is rejected."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def flash_composes_with_shard_map() -> bool:
+    """Whether the kernels can run inside ``shard_map`` on this backend:
+    true on compiled TPU; Pallas interpret mode trips vma checks on its
+    internal dynamic_slices. CLI entrypoints use this to reject
+    --flash --dp off-TPU with a clear message instead of a deep trace."""
+    return jax.default_backend() == "tpu"
+
 def _block_sizes(s, block_q, block_k, mask, interpret):
     bq, bk = min(block_q, s), min(block_k, s)
     if s % bq or s % bk:
@@ -273,8 +301,8 @@ def _flash_forward(q, k, v, mask, seed, block_q, block_k, interpret, causal,
     o, lse = pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+            _sds(q.shape, q.dtype, _union_vma(q, k, v, mask, seed)),
+            _sds((b, h, s, 1), jnp.float32, _union_vma(q, k, v, mask, seed)),
         ),
         grid=grid,
         in_specs=in_specs,
@@ -472,9 +500,10 @@ def _flash_backward(q, k, v, mask, seed, o, lse, g, block_q, block_k,
             ),
             **common,
         )
+    bwd_vma = _union_vma(q, k, v, mask, seed, g, lse, delta)
     dq = pl.pallas_call(
         dq_kernel,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=_sds(q.shape, q.dtype, bwd_vma),
         grid=(b, h, s // bq, s // bk),
         in_specs=in_specs,
         out_specs=q_by_iq,
@@ -498,8 +527,8 @@ def _flash_backward(q, k, v, mask, seed, o, lse, g, block_q, block_k,
     operands += [seed_arr, g, lse, delta]
 
     out_shapes = [
-        jax.ShapeDtypeStruct(k.shape, k.dtype),
-        jax.ShapeDtypeStruct(v.shape, v.dtype),
+        _sds(k.shape, k.dtype, bwd_vma),
+        _sds(v.shape, v.dtype, bwd_vma),
     ]
     out_specs = [kv_by_third, kv_by_third]
     scratch = [
@@ -507,7 +536,7 @@ def _flash_backward(q, k, v, mask, seed, o, lse, g, block_q, block_k,
         pltpu.VMEM((bk, d), jnp.float32),
     ]
     if mask is not None:
-        out_shapes.append(jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32))
+        out_shapes.append(_sds((b, h, 1, s), jnp.float32, bwd_vma))
         out_specs.append(
             pl.BlockSpec((1, 1, 1, bk), lambda b_, h_, ik, iq: (b_, h_, 0, ik))
         )
