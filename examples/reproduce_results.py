@@ -42,13 +42,17 @@ MNIST_RUNS = [
     ("mnist_03_2w_b100_k1", ["--variant", "03", "--max-steps", "1500"]),
     ("mnist_04_2w_b50_k2", ["--variant", "04", "--max-steps", "3000"]),
 ]
+# --train-size 25600 = 3200 steps x micro-batch 8: a fresh single-epoch
+# stream. Both arms consume the SAME budget (3,200 micro-steps), and neither
+# can memorize the label noise — they floor at its entropy, reproducing the
+# reference's "K=4 tighter at the same floor" claim (README.md:78)
 BERT_RUNS = [
     ("bert_cola_k4_eff32",
      ["--task", "cola", "--accum-k", "4", "--max-steps", "3200",
-      "--label-noise", "0.15"]),
+      "--label-noise", "0.15", "--train-size", "25600"]),
     ("bert_cola_k1_eff8",
      ["--task", "cola", "--accum-k", "1", "--max-steps", "3200",
-      "--label-noise", "0.15"]),
+      "--label-noise", "0.15", "--train-size", "25600"]),
 ]
 HOUSING_RUN = ("housing_b59_k3", ["--max-steps", "3000"])
 
@@ -96,12 +100,26 @@ def run_one(script, name, extra, run_root, quick, cpu_mesh=True,
     return model_dir, acc
 
 
-from examples.plot_loss import read_curve, read_curve_file  # noqa: E402
+from examples.plot_loss import read_curve_file  # noqa: E402
 
 
 def tail_mean(losses, frac=0.1):
     n = max(1, int(len(losses) * frac))
     return sum(losses[-n:]) / n
+
+
+def curve_stats(steps, losses):
+    """The summary entry derivable from a loss CSV alone. Shared with
+    tests/test_results_integrity.py, which asserts every committed
+    ``summary.json`` entry equals this function of its committed CSV."""
+    import numpy as np
+
+    return {
+        "steps": steps[-1],
+        "tail_loss_mean": round(tail_mean(losses), 4),
+        "tail_loss_std": round(
+            float(np.std(losses[-max(1, len(losses) // 10):])), 4),
+    }
 
 
 def overlay(out_png, curves, title, smooth=25):
@@ -153,67 +171,43 @@ def main(argv=None):
     # sweep alongside a TPU bert sweep) must not clobber each other
     run_root = Path(tempfile.mkdtemp(prefix="gradaccum_results_"))
 
-    # merge into the existing summary so an --only rerun of one group never
-    # wipes the other groups' measured numbers
-    summary = {"quick": args.quick, "runs": {}}
+    # metric fields that come from the RUN (not the curve): preserved from
+    # the prior summary for groups an --only rerun did not touch
     summary_path = out / "summary.json"
+    prior_runs = {}
     if summary_path.exists():
         with open(summary_path) as f:
-            summary["runs"] = json.load(f).get("runs", {})
+            prior_runs = json.load(f).get("runs", {})
 
-    mnist_curves, bert_curves = {}, {}
+    # {name: extra fields merged into the curve-derived entry}
+    fresh_metrics = {}
 
-    import numpy as np
-
-    def record(name, curves, steps, losses, acc=None, reloaded=False,
-               metric_key="final_accuracy"):
-        if curves is not None:
-            curves[name] = (steps, losses)
-        if reloaded and name in summary["runs"]:
-            return  # keep the previously measured entry verbatim
-        entry = {
-            metric_key: acc,
-            "steps": steps[-1],
-            "tail_loss_mean": round(tail_mean(losses), 4),
-            "tail_loss_std": round(
-                float(np.std(losses[-max(1, len(losses) // 10):])), 4),
-        }
+    def ran(name, acc, metric_key="final_accuracy"):
+        fields = {metric_key: acc}
         if args.quick:
             # keep 10x-shortened smoke entries distinguishable from full-run
             # evidence when merged into an existing summary
-            entry["quick"] = True
-        summary["runs"][name] = entry
-
-    def reload(name, curves):
-        """Reload a previously measured curve for an --only rerun of another
-        group; a missing file (fresh --out dir) skips the overlay entry
-        instead of failing after the requested group already ran."""
-        path = out / f"{name}.csv"
-        if not path.exists():
-            print(f"[results] no prior curve for {name} ({path}); skipping")
-            return
-        record(name, curves, *read_curve_file(path), reloaded=True)
+            fields["quick"] = True
+        fresh_metrics[name] = fields
 
     for name, extra in MNIST_RUNS:
         if args.only not in ("all", "mnist"):
-            reload(name, mnist_curves)
             continue
         model_dir, acc = run_one("mnist.py", name, extra, run_root,
                                  args.quick, run_timeout=args.run_timeout)
         shutil.copy(os.path.join(model_dir, "loss_vs_step.csv"),
                     out / f"{name}.csv")
-        record(name, mnist_curves, *read_curve(model_dir), acc=acc)
+        ran(name, acc)
 
     for name, extra in BERT_RUNS:
         if args.only not in ("all", "bert"):
-            reload(name, bert_curves)
             continue
         model_dir, acc = run_one("bert_finetune.py", name, extra, run_root,
                                  args.quick, cpu_mesh=False,
                                  run_timeout=args.run_timeout)
         shutil.copy(os.path.join(model_dir, "loss_vs_step.csv"),
                     out / f"{name}.csv")
-        record(name, bert_curves, *read_curve(model_dir), acc=acc)
+        ran(name, acc)
 
     if args.only in ("all", "housing"):
         name, extra = HOUSING_RUN
@@ -221,8 +215,34 @@ def main(argv=None):
                                   args.quick, run_timeout=args.run_timeout)
         shutil.copy(os.path.join(model_dir, "loss_vs_step.csv"),
                     out / f"{name}.csv")
-        record(name, None, *read_curve(model_dir), acc=rmse,
-               metric_key="final_test_rmse")
+        ran(name, rmse, metric_key="final_test_rmse")
+
+    # Summary + plots derive STRICTLY from the CSVs now sitting in --out —
+    # never from in-memory curves — so summary.json can't desync from the
+    # committed evidence (tests/test_results_integrity.py asserts this).
+    summary = {"quick": args.quick, "runs": {}}
+    mnist_curves, bert_curves = {}, {}
+    groups = (
+        [(n, mnist_curves) for n, _ in MNIST_RUNS]
+        + [(n, bert_curves) for n, _ in BERT_RUNS]
+        + [(HOUSING_RUN[0], None)]
+    )
+    metric_fields = ("final_accuracy", "final_test_rmse", "quick")
+    for name, curves in groups:
+        path = out / f"{name}.csv"
+        if not path.exists():
+            print(f"[results] no curve for {name} ({path}); skipping")
+            continue
+        steps, losses = read_curve_file(path)
+        if curves is not None:
+            curves[name] = (steps, losses)
+        entry = curve_stats(steps, losses)
+        if name in fresh_metrics:
+            entry.update(fresh_metrics[name])
+        else:  # untouched group: carry the previously measured metric only
+            entry.update({k: prior_runs[name][k] for k in metric_fields
+                          if k in prior_runs.get(name, {})})
+        summary["runs"][name] = entry
 
     suffix = " — QUICK SMOKE (10x fewer steps)" if args.quick else ""
     overlay(out / "mnist_matrix.png", mnist_curves,

@@ -468,3 +468,46 @@ def test_estimator_zero1_streaming_mode(rng):
         assert all(
             l.sharding.is_fully_replicated for l in jax.tree.leaves(tree)
         )
+
+
+def test_estimator_sparse_embed_parity(rng):
+    """Estimator(sparse_embed=True) trains to the same parameters as the
+    dense path — on the no-mesh jit path AND the DP shard_map path."""
+    cfg = BertConfig.tiny_for_tests()
+    train = _data(rng, cfg)
+
+    def run(sparse, mesh=None):
+        est = gt.Estimator(
+            bert_classifier_bundle(cfg, num_classes=2),
+            gt.ops.adamw(
+                gt.warmup_polynomial_decay(1e-3, num_train_steps=100,
+                                           num_warmup_steps=10),
+                weight_decay_rate=0.01,
+            ),
+            gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0),
+            gt.RunConfig(seed=7),
+            mesh=mesh,
+            mode="scan",
+            sparse_embed=sparse,
+        )
+        state = est.train(_train_fn(train), max_steps=MAX_STEPS)
+        return state.params
+
+    base = run(False)
+    _assert_params_close(run(True), base)
+    mesh = make_mesh(data=2)
+    _assert_params_close(run(True, mesh=mesh), base)
+
+
+def test_estimator_sparse_embed_rejects_bad_combos():
+    from gradaccum_tpu.models.mnist_cnn import mnist_cnn_bundle
+
+    cfg = BertConfig.tiny_for_tests()
+    opt = gt.ops.adamw(gt.warmup_polynomial_decay(1e-3, 100, 10))
+    accum = gt.GradAccumConfig(num_micro_batches=K)
+    with pytest.raises(ValueError, match="mode='scan'"):
+        gt.Estimator(bert_classifier_bundle(cfg, num_classes=2), opt, accum,
+                     mode="streaming", sparse_embed=True)
+    with pytest.raises(ValueError, match="sparse_embed hooks"):
+        gt.Estimator(mnist_cnn_bundle(), opt, accum, mode="scan",
+                     sparse_embed=True)

@@ -45,30 +45,50 @@ def test_summary_matches_committed_csvs():
 
 
 def test_committed_csvs_all_summarized():
-    """No orphan curves: every committed loss CSV appears in the summary."""
-    summary = _summary()
-    for path in RESULTS.glob("*.csv"):
-        if path.stem.startswith("longcontext"):
-            continue  # kernel-scaling artifact, not a loss curve
+    """No orphan curves: every committed loss CSV appears in the summary —
+    and committing curves WITHOUT a summary is itself a failure (a skip
+    here would let stale evidence ship green)."""
+    curves = [p for p in RESULTS.glob("*.csv")
+              if not p.stem.startswith("longcontext")]
+    if not (RESULTS / "summary.json").exists():
+        assert not curves, (
+            f"loss CSVs committed without results/summary.json: "
+            f"{[p.name for p in curves]} — rerun examples/reproduce_results.py"
+        )
+        pytest.skip("no committed results yet")
+    with open(RESULTS / "summary.json") as f:
+        summary = json.load(f)
+    for path in curves:
         assert path.stem in summary["runs"], (
             f"{path.name} committed but absent from summary.json"
         )
 
 
+def test_bert_arms_config_is_fresh_single_epoch_stream():
+    """Static config invariant — runs with or without committed artifacts:
+    both arms share one micro-step budget and the synthetic corpus is at
+    least steps x micro-batch, so neither arm can memorize the label noise
+    (round-2 verdict, Weak #3)."""
+    from examples.bert_finetune import TASKS
+
+    micro = TASKS["cola"]["batch"]
+    budgets = set()
+    for _, extra in BERT_RUNS:
+        opts = dict(zip(extra[::2], extra[1::2]))
+        budgets.add(opts["--max-steps"])
+        assert int(opts["--train-size"]) >= int(opts["--max-steps"]) * micro
+    assert len(budgets) == 1, f"unequal arm budgets: {budgets}"
+
+
 def test_bert_arms_ran_equal_budgets():
-    """The two BERT arms are x-comparable: same micro-step budget (the
-    round-2 verdict flagged 3,200 vs 1,600), and the config pins a fresh
-    single-epoch corpus so neither arm can memorize the label noise."""
+    """The committed evidence itself is x-comparable: same recorded step
+    count in both arms (the round-2 verdict flagged 3,200 vs 1,600)."""
     summary = _summary()
     k4 = summary["runs"].get("bert_cola_k4_eff32")
     k1 = summary["runs"].get("bert_cola_k1_eff8")
     if not (k4 and k1):
         pytest.skip("BERT arms not in committed summary")
     assert k4["steps"] == k1["steps"], (k4["steps"], k1["steps"])
-    # fresh-stream config: corpus >= steps x micro-batch for both arms
-    for _, extra in BERT_RUNS:
-        opts = dict(zip(extra[::2], extra[1::2]))
-        assert int(opts["--train-size"]) >= int(opts["--max-steps"]) * 8
 
 
 def test_bert_noise_floor_not_memorized():
