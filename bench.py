@@ -5,22 +5,30 @@ BERT-Small L-4 H-512 A-8, seq 128, per-device micro-batch 8, K=4 gradient
 accumulation. North-star from BASELINE.json: >= 1,000 seq/s on TPU.
 
 Measures the full scan-mode train step (forward + backward + AdamW with
-warmup/decay schedule + clip-after-average) in bfloat16 and prints ONE JSON
-line with both raw throughput (seq/s) and MFU from an analytic FLOPs model.
+warmup/decay schedule + clip-after-average) in bfloat16 and prints JSON
+lines with both raw throughput (seq/s) and MFU from an analytic FLOPs model.
+The driver parses the LAST parsable line.
 
-Resilience: the axon TPU tunnel is known to flake at backend init, and its
-outages last from minutes to HOURS (it cost rounds 1 and 2 their TPU perf
-artifacts). JAX caches a failed backend init for the life of the process, so
-the measurement runs in a child process. The orchestrator spreads cheap
-liveness probes across the whole driver window (default 3 h, tunable via
-BENCH_TPU_WAIT_S) and fires the full measurement the moment a probe
-succeeds; the clearly-labeled CPU fallback is the final act only.
+Resilience (this structure is load-bearing — rounds 1-3 lost their perf
+artifacts to it): the axon TPU tunnel's failure mode is a HANG at backend
+init, outages last hours, and the driver's window is ~30 minutes. So the
+orchestrator banks a short, clearly-labeled CPU measurement FIRST and
+prints its JSON line immediately; only then does it spend the remaining
+window probing the TPU, and prints a second JSON line the moment a live
+probe leads to a successful measurement. A dead tunnel still yields a
+parsable CPU artifact; a live tunnel upgrades it.
 
-On an accelerator the tune pass races the dense and sparse-embedding-grad
-engines (ops/accumulation.py vs ops/sparse_embed.py) across scan `unroll`
-in {1,2,4} — short passes, then a full-length pass on the winner.
-GRADACCUM_UNROLL pins the unroll; GRADACCUM_SPARSE_EMBED=1/0 pins the
-engine.
+On an accelerator the tune pass races four engines -- dense, sparse
+(token-level embedding-grad accumulation, ops/sparse_embed.py), flash
+(Pallas fused attention fwd+bwd, ops/flash_attention.py), and
+flash_sparse (both) -- across scan `unroll` in {1,2,4}: short passes,
+then a full-length pass on the winner. The flash engines need the
+compiled TPU kernel (interpret mode off-TPU is correctness-only), so off
+TPU they are skipped (or a flash pin demoted) with the reason recorded
+under the JSON line's `tune_skipped` key.
+GRADACCUM_UNROLL pins the unroll; GRADACCUM_ENGINE pins the engine
+(dense/sparse/flash/flash_sparse); GRADACCUM_SPARSE_EMBED=1/0 is the
+legacy engine pin.
 """
 
 import argparse
@@ -33,6 +41,9 @@ import time
 K, MICRO, SEQ = 4, 8, 128
 VOCAB = 30522
 NUM_CLASSES = 2
+
+ENGINES = ("dense", "sparse", "flash", "flash_sparse")
+FLASH_SKIP_REASON = "skipped: Pallas kernels are interpret-only off-TPU"
 
 
 def measure(iters, warmup, unrolls, tune_iters):
@@ -54,10 +65,23 @@ def measure(iters, warmup, unrolls, tune_iters):
     from gradaccum_tpu.ops.accumulation import scan_init
 
     dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
     print(f"[bench] device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
 
     cfg = BertConfig.small(vocab_size=VOCAB, dtype=jnp.bfloat16)
-    bundle = bert_classifier_bundle(cfg, num_classes=NUM_CLASSES)
+    bundles = {"dense": bert_classifier_bundle(cfg, num_classes=NUM_CLASSES)}
+
+    def get_bundle(engine):
+        # flash engines share one bundle; the param tree is identical to the
+        # dense bundle's (attention_fn carries no parameters)
+        key = "flash" if engine.startswith("flash") else "dense"
+        if key not in bundles:
+            from gradaccum_tpu.ops.flash_attention import flash_attention
+
+            bundles[key] = bert_classifier_bundle(
+                cfg, num_classes=NUM_CLASSES, attention_fn=flash_attention
+            )
+        return bundles[key]
 
     rng = np.random.default_rng(0)
     batch = {
@@ -67,7 +91,7 @@ def measure(iters, warmup, unrolls, tune_iters):
         "label": rng.integers(0, 2, size=(K * MICRO,)).astype(np.int32),
     }
     sample = jax.tree.map(lambda x: x[:MICRO], batch)
-    params = bundle.init(jax.random.PRNGKey(0), sample)
+    params = bundles["dense"].init(jax.random.PRNGKey(0), sample)
 
     schedule = gt.warmup_polynomial_decay(2e-5, num_train_steps=10000,
                                           num_warmup_steps=1000)
@@ -77,12 +101,32 @@ def measure(iters, warmup, unrolls, tune_iters):
     key = jax.random.PRNGKey(1)
 
     steps = {}
+    tune_report = {}
 
-    # GRADACCUM_SPARSE_EMBED pins the engine (1 = sparse, 0 = dense); unset
-    # lets the tune pass race both when it runs at all
-    pin = os.environ.get("GRADACCUM_SPARSE_EMBED")
-    engines = ("sparse",) if pin == "1" else (
-        ("dense",) if pin == "0" or len(unrolls) == 1 else ("dense", "sparse")
+    pin = os.environ.get("GRADACCUM_ENGINE")
+    legacy = os.environ.get("GRADACCUM_SPARSE_EMBED")
+    if pin is None and legacy is not None:
+        pin = {"1": "sparse", "0": "dense"}.get(legacy)
+    if pin is not None and pin not in ENGINES:
+        print(f"[bench] ignoring unknown GRADACCUM_ENGINE={pin!r}",
+              file=sys.stderr)
+        pin = None
+    if pin is not None and pin.startswith("flash") and not on_tpu:
+        # interpret-mode flash is correctness-only and orders of magnitude
+        # slow; honoring the pin would poison (or time out) the CPU artifact
+        demoted = "sparse" if pin.endswith("sparse") else "dense"
+        print(f"[bench] demoting GRADACCUM_ENGINE={pin} to {demoted} off-TPU: "
+              f"{FLASH_SKIP_REASON}", file=sys.stderr)
+        pin = demoted
+    if pin is not None:
+        engines = (pin,)
+    elif len(unrolls) == 1 and not on_tpu:
+        engines = ("dense",)  # the quick CPU pass: no tune racing
+    else:
+        engines = ENGINES if on_tpu else ("dense", "sparse")
+    tune_skipped = (
+        {"flash": FLASH_SKIP_REASON, "flash_sparse": FLASH_SKIP_REASON}
+        if not on_tpu else None
     )
 
     def build_step(engine, unroll):
@@ -90,7 +134,8 @@ def measure(iters, warmup, unrolls, tune_iters):
             cfg_a = gt.GradAccumConfig(  # full pass reuses its tune compile
                 num_micro_batches=K, clip_norm=1.0, unroll=unroll
             )
-            if engine == "sparse":
+            bundle = get_bundle(engine)
+            if engine.endswith("sparse"):
                 from gradaccum_tpu.ops.sparse_embed import (
                     accumulate_scan_sparse_embed,
                 )
@@ -113,7 +158,6 @@ def measure(iters, warmup, unrolls, tune_iters):
         per_step, state = time_device_steps(step, state, (stacked, key), n)
         return per_step, state
 
-    tune_report = {}
     candidates = [(e, u) for e in engines for u in unrolls]
     if len(candidates) > 1:
         best_cand, best = None, float("inf")
@@ -150,6 +194,8 @@ def measure(iters, warmup, unrolls, tune_iters):
     }
     if tune_report:
         result["tune_seq_s"] = tune_report
+    if tune_skipped:
+        result["tune_skipped"] = tune_skipped
     return result
 
 
@@ -225,20 +271,65 @@ def _run_measurement(label, env, worker_args, timeout_s):
     return None, "rc=0 but no JSON line"
 
 
+def _emit(result):
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+
 def run_orchestrator(args):
-    """Probe for the accelerator across the whole driver window; measure the
-    moment a probe succeeds. Never exits without a JSON line."""
-    wait_budget = float(os.environ.get("BENCH_TPU_WAIT_S", 3 * 3600))
+    """Bank a CPU number first; upgrade to a TPU number if the tunnel lives.
+
+    The driver records the LAST parsable JSON line, so the ordering
+    cpu-line-then-maybe-tpu-line means: dead tunnel -> labeled CPU artifact,
+    live tunnel -> real TPU artifact. Round 3 proved the inverse ordering
+    (wait-for-TPU-then-CPU-fallback) banks NOTHING when the wait budget
+    exceeds the driver window (BENCH_r03: rc=124, parsed=null)."""
+    wait_budget = float(os.environ.get("BENCH_TPU_WAIT_S", 1200))
     probe_interval = float(os.environ.get("BENCH_PROBE_INTERVAL_S", 150))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 120))
+    measure_timeout = float(os.environ.get("BENCH_MEASURE_TIMEOUT_S", 1500))
+    # the driver kills the whole bench at ~30 min; never start a measurement
+    # that cannot finish inside that outer window
+    total_window = float(os.environ.get("BENCH_TOTAL_WINDOW_S", 1680))
     start = time.monotonic()
-    deadline = start + wait_budget
 
     attempts = []           # bounded narrative for the JSON diagnostics
-    probe_failures = 0      # consecutive-failure collapse so 70 probes != 70 lines
+    banked = False
+
+    # --- Act 1: the guaranteed artifact. Short CPU measurement, ~3 min. ---
+    cpu_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    result, detail = _run_measurement(
+        "cpu-first", cpu_env,
+        ["--iters", "3", "--warmup", "1", "--unrolls", "1"],
+        timeout_s=900,
+    )
+    if result is not None:
+        result["bench_attempts"] = ["cpu-first: ok"]
+        _emit(result)
+        banked = True
+        attempts.append("cpu-first: ok (banked)")
+    else:
+        attempts.append(f"cpu-first: {detail}")
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # env is cpu-forced: the CPU number IS the result, nothing to upgrade
+        if banked:
+            return 0
+        _emit({
+            "metric": "bert_small_seq128_effbatch32_train_throughput",
+            "value": 0.0, "unit": "seq/s", "vs_baseline": 0.0, "mfu": None,
+            "error": "cpu-forced env and the CPU measurement failed",
+            "bench_attempts": attempts,
+        })
+        return 1
+
+    # --- Act 2: spend the remaining window trying to upgrade to TPU. ---
+    deadline = start + wait_budget
+    probe_failures = 0      # consecutive-failure collapse so 8 probes != 8 lines
     last_probe_detail = ""
     measurement_failures = 0
-    cpu_only = False
+    probe_n = 0
+    tpu_declined = False    # live TPU seen, but too late in the window
 
     def flush_probe_failures():
         nonlocal probe_failures
@@ -248,7 +339,6 @@ def run_orchestrator(args):
             )
             probe_failures = 0
 
-    probe_n = 0
     while time.monotonic() < deadline and measurement_failures < 3:
         probe_n += 1
         t_probe = time.monotonic()
@@ -260,12 +350,6 @@ def run_orchestrator(args):
             probe_failures += 1
             last_probe_detail = detail
         elif platform == "cpu":
-            if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-                # genuinely CPU-forced: deterministic, stop waiting
-                flush_probe_failures()
-                attempts.append(f"probe #{probe_n}: env is cpu-forced")
-                cpu_only = True
-                break
             # a fast TPU-init failure makes JAX fall back to CPU in-process;
             # that is still a tunnel outage, so keep waiting out the window
             probe_failures += 1
@@ -275,17 +359,25 @@ def run_orchestrator(args):
             attempts.append(
                 f"probe #{probe_n} at t+{mins:.1f}min: {platform} live"
             )
+            window_left = start + total_window - time.monotonic()
+            if banked and window_left < 300:
+                attempts.append(
+                    f"{platform} live but only {window_left:.0f}s of window "
+                    "left; keeping the banked CPU line"
+                )
+                tpu_declined = True
+                break
             result, detail = _run_measurement(
                 f"measure-{measurement_failures + 1}", dict(os.environ),
                 ["--iters", str(args.iters), "--warmup", str(args.warmup),
                  "--unrolls", args.unrolls, "--tune-iters",
                  str(args.tune_iters)],
-                timeout_s=1800,
+                timeout_s=min(measure_timeout, max(window_left, 300)),
             )
             if result is not None:
                 result["bench_attempts"] = attempts + ["measurement: ok"]
                 result["bench_wait_min"] = round(mins, 1)
-                print(json.dumps(result))
+                _emit(result)
                 return 0
             measurement_failures += 1
             attempts.append(f"measurement {measurement_failures}: {detail}")
@@ -295,33 +387,35 @@ def run_orchestrator(args):
         elapsed = time.monotonic() - t_probe
         time.sleep(min(max(probe_interval - elapsed, 0), remaining))
     flush_probe_failures()
-
-    if not cpu_only:
+    if not tpu_declined:
         attempts.append(
-            f"accelerator never measured within {wait_budget / 60:.0f}min window"
+            f"tpu never measured within {wait_budget / 60:.0f}min window"
         )
-    print("[bench] falling back to CPU (clearly labeled)", file=sys.stderr)
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+        print(f"[bench] no TPU within the window; CPU line "
+              f"{'stands' if banked else 'MISSING'}", file=sys.stderr)
+    if banked:
+        return 0
+    # CPU failed earlier AND no TPU. Emit the diagnostic line FIRST (a later
+    # success line would override it under last-parsable-line semantics), so
+    # even a driver kill mid-retry leaves a parsable artifact.
+    _emit({
+        "metric": "bert_small_seq128_effbatch32_train_throughput",
+        "value": 0.0, "unit": "seq/s", "vs_baseline": 0.0, "mfu": None,
+        "error": "cpu-first failed and no tpu within the window",
+        "bench_attempts": list(attempts),
+    })
+    retry_budget = start + total_window - time.monotonic()
+    if retry_budget < 120:
+        return 1
     result, detail = _run_measurement(
-        "cpu-fallback", env,
+        "cpu-retry", cpu_env,
         ["--iters", "3", "--warmup", "1", "--unrolls", "1"],
-        timeout_s=1800,
+        timeout_s=min(900, retry_budget),
     )
     if result is not None:
-        result["bench_attempts"] = attempts + ["cpu-fallback: ok"]
-        print(json.dumps(result))
+        result["bench_attempts"] = attempts + ["cpu-retry: ok"]
+        _emit(result)
         return 0
-    attempts.append(f"cpu-fallback: {detail}")
-    # Every attempt failed: still print one parsable JSON line with diagnostics.
-    print(json.dumps({
-        "metric": "bert_small_seq128_effbatch32_train_throughput",
-        "value": 0.0,
-        "unit": "seq/s",
-        "vs_baseline": 0.0,
-        "mfu": None,
-        "error": "all bench attempts failed",
-        "bench_attempts": attempts,
-    }))
     return 1
 
 
