@@ -145,6 +145,14 @@ class Estimator:
                     "pipeline composes with the 'data' axis only (no "
                     "sharding_rules / 'seq' axis)"
                 )
+            if accum.first_step_quirk:
+                raise ValueError(
+                    "pipeline runs on the scan path, which has no "
+                    "first-step quirk (the reference's step-0 apply, "
+                    "optimization.py:91, is a streaming-mode semantic); "
+                    "pass GradAccumConfig(first_step_quirk=False) to "
+                    "acknowledge the schedule starts at a full K-cycle"
+                )
         if zero1:
             if axes.get(DATA_AXIS, 1) < 2:
                 raise ValueError("zero1 requires a mesh with a 'data' axis")

@@ -128,6 +128,11 @@ def prefill(params, cfg: GPTConfig, prompt_ids, max_len: int):
     length and mask via the causal structure if needed).
     """
     b, s0 = prompt_ids.shape
+    if s0 > max_len:
+        raise ValueError(
+            f"prompt length {s0} exceeds max_len {max_len}: the KV cache "
+            "is allocated at max_len, so the prompt cannot fit"
+        )
     cache = init_cache(cfg, b, max_len)
     x = _embed(params, cfg, prompt_ids, jnp.arange(s0)[None, :])
     causal = jnp.tril(jnp.ones((s0, s0), jnp.float32))

@@ -72,14 +72,16 @@ def _hash_u32(x):
     return x ^ (x >> jnp.uint32(16))
 
 
-def _keep_from_counter(counter, bh, seed, keep_threshold):
-    """``counter``: uint32 position index within one (batch, head) slice —
-    q·S + k, which stays collision-free for S < 2¹⁶; ``bh``: the (batch,
-    head) slice index, folded into a DERIVED per-slice seed rather than the
-    counter, so distinct slices get independent streams with no 2³²
-    flat-index wraparound (B·H·S² can exceed 2³² at long context)."""
+def _keep_from_positions(q_pos, k_pos, bh, seed, keep_threshold):
+    """Stateless keep/drop decision chain: ``seed`` + ``bh`` (the (batch,
+    head) slice index) hash to a per-slice seed, that + ``q_pos`` hash to a
+    per-row seed, and ``k_pos`` mixes last. Three hash stages instead of a
+    flat ``q·S + k`` counter, so no index ever wraps uint32 — decisions stay
+    independent at any sequence length (a flat counter collides for
+    S ≥ 2¹⁶, exactly the long-context regime these kernels target)."""
     slice_seed = _hash_u32(seed + bh * jnp.uint32(_GOLDEN))
-    return _hash_u32(counter + slice_seed * jnp.uint32(_GOLDEN)) < keep_threshold
+    row_seed = _hash_u32(q_pos + slice_seed * jnp.uint32(_GOLDEN))
+    return _hash_u32(k_pos + row_seed * jnp.uint32(_GOLDEN)) < keep_threshold
 
 
 def _tile_keep(b, h, iq_start, ik_start, bq, bk, *, num_heads, seq, seed,
@@ -87,11 +89,11 @@ def _tile_keep(b, h, iq_start, ik_start, bq, bk, *, num_heads, seq, seed,
     """[bq, bk] keep mask for the tile at (b, h, iq_start, ik_start). The
     SAME formula runs in the forward kernel, both backward kernels, and
     :func:`dropout_keep_mask`."""
+    del seq  # decisions are position-keyed, not flat-indexed
     q_pos = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0) + jnp.uint32(iq_start)
     k_pos = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1) + jnp.uint32(ik_start)
     bh = jnp.uint32(b) * jnp.uint32(num_heads) + jnp.uint32(h)
-    counter = q_pos * jnp.uint32(seq) + k_pos
-    return _keep_from_counter(counter, bh, seed, keep_threshold)
+    return _keep_from_positions(q_pos, k_pos, bh, seed, keep_threshold)
 
 
 def dropout_keep_mask(seed, batch, num_heads, seq, rate):
@@ -105,9 +107,8 @@ def dropout_keep_mask(seed, batch, num_heads, seq, rate):
     qp = jax.lax.broadcasted_iota(jnp.uint32, shape, 2)
     kp = jax.lax.broadcasted_iota(jnp.uint32, shape, 3)
     bh = b * jnp.uint32(num_heads) + h
-    counter = qp * jnp.uint32(seq) + kp
-    return _keep_from_counter(counter, bh, jnp.asarray(seed, jnp.uint32),
-                              keep_threshold)
+    return _keep_from_positions(qp, kp, bh, jnp.asarray(seed, jnp.uint32),
+                                keep_threshold)
 
 
 def _dropout_config(dropout_rate):

@@ -248,7 +248,8 @@ def test_estimator_pipeline_trains_and_evals(rng, tmp_path, pipe, dp):
         return gt.Estimator(
             bert_classifier_bundle(cfg, num_classes=2),
             gt.ops.adamw(1e-3, weight_decay_rate=0.01),
-            gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0),
+            gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0,
+                               first_step_quirk=False),
             gt.RunConfig(seed=7, model_dir=model_dir),
             mesh=mesh, mode="scan", pipeline=pipeline,
         )
@@ -294,7 +295,7 @@ def test_estimator_pipeline_rejects_bad_combos():
     cfg = BertConfig.tiny_for_tests(hidden_dropout=0.0, attention_dropout=0.0)
     bundle = bert_classifier_bundle(cfg, num_classes=2)
     spec = bert_pipeline_spec(cfg, n_stages=2)
-    accum = gt.GradAccumConfig(num_micro_batches=K)
+    accum = gt.GradAccumConfig(num_micro_batches=K, first_step_quirk=False)
     with pytest.raises(ValueError, match="pipe"):
         gt.Estimator(bundle, gt.ops.adamw(1e-3), accum,
                      mode="scan", pipeline=spec)  # no mesh
@@ -306,6 +307,12 @@ def test_estimator_pipeline_rejects_bad_combos():
         gt.Estimator(bundle, gt.ops.adamw(1e-3), accum, mesh=mesh,
                      mode="scan", pipeline=spec,
                      sharding_rules=bert_tp_rules())
+    # the quirk is streaming-only: a default (quirk=True) config must be
+    # rejected rather than silently ignored on the pipeline path
+    with pytest.raises(ValueError, match="first_step_quirk"):
+        gt.Estimator(bundle, gt.ops.adamw(1e-3),
+                     gt.GradAccumConfig(num_micro_batches=K), mesh=mesh,
+                     mode="scan", pipeline=spec)
 
 
 @pytest.mark.parametrize("rules", [None, "tp"], ids=["dp8", "dp4xtp2"])

@@ -327,6 +327,29 @@ def test_dropout_keep_fraction_and_seed_sensitivity():
     assert bool(jnp.any(a != b))  # different seeds, different masks
 
 
+def test_dropout_no_long_context_counter_wrap():
+    """A flat q*S+k counter collides for S >= 2**16: (q, k) and (q+1, k-S)
+    would reuse one decision. The position-keyed hash chain must give
+    independent decisions for exactly those aliased pairs at huge S."""
+    from gradaccum_tpu.ops.flash_attention import (
+        _dropout_config, _keep_from_positions,
+    )
+
+    seq = jnp.uint32(1 << 20)  # far past the wrap boundary
+    rate = 0.5  # maximal disagreement probability for independent decisions
+    threshold, _ = _dropout_config(rate)
+    seed = jnp.uint32(1234)
+    bh = jnp.uint32(3)
+    q = jnp.arange(4096, dtype=jnp.uint32)
+    k = jnp.arange(4096, dtype=jnp.uint32) + jnp.uint32(17)
+    a = _keep_from_positions(q, k, bh, seed, threshold)
+    # the flat-counter alias of each (q, k): counter identical => the OLD
+    # formula returned bitwise-equal decisions for this whole vector
+    b = _keep_from_positions(q + 1, k - seq, bh, seed, threshold)
+    disagree = float(jnp.mean((a != b).astype(jnp.float32)))
+    assert disagree > 0.3, f"aliased positions still correlated: {disagree}"
+
+
 def test_dropout_validation(rng):
     q, k, v, mask = _qkv_mask(rng)
     with pytest.raises(ValueError, match="dropout_rng"):

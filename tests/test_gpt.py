@@ -243,6 +243,10 @@ def test_prefill_logits_match_model(rng):
     np.testing.assert_allclose(np.asarray(logits), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
 
+    # a prompt longer than the cache must fail loudly, not inside jnp.pad
+    with pytest.raises(ValueError, match="max_len"):
+        prefill(params, cfg, jnp.asarray(prompt), 8)
+
 
 def test_decode_step_positions_and_cache_growth(rng):
     from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
