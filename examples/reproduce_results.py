@@ -54,6 +54,17 @@ BERT_RUNS = [
      ["--task", "cola", "--accum-k", "1", "--max-steps", "3200",
       "--label-noise", "0.15", "--train-size", "25600"]),
 ]
+# the reference's flagship CHAIN — pretrained checkpoint -> warm-start ->
+# fine-tune -> evaluate (README.md:66-78) — on the committed HF-format
+# fixture (tests/fixtures/make_bert_hf_fixture.py): real on-disk format,
+# real TSV data path, tiny seeded weights (zero-egress stand-in)
+BERT_HF_RUN = (
+    "bert_cola_hf_warmstart",
+    ["--task", "cola",
+     "--hf-checkpoint", "tests/fixtures/bert_hf_tiny",
+     "--data-dir", "tests/fixtures/bert_hf_tiny",
+     "--seq-len", "32", "--accum-k", "4", "--max-steps", "600"],
+)
 HOUSING_RUN = ("housing_b59_k3", ["--max-steps", "3000"])
 
 
@@ -199,7 +210,7 @@ def main(argv=None):
                     out / f"{name}.csv")
         ran(name, acc)
 
-    for name, extra in BERT_RUNS:
+    for name, extra in BERT_RUNS + [BERT_HF_RUN]:
         if args.only not in ("all", "bert"):
             continue
         model_dir, acc = run_one("bert_finetune.py", name, extra, run_root,
@@ -225,7 +236,9 @@ def main(argv=None):
     groups = (
         [(n, mnist_curves) for n, _ in MNIST_RUNS]
         + [(n, bert_curves) for n, _ in BERT_RUNS]
-        + [(HOUSING_RUN[0], None)]
+        # summarized but not overlaid: a different (tiny-fixture) model
+        # scale than the K4-vs-K1 comparison figure
+        + [(BERT_HF_RUN[0], None), (HOUSING_RUN[0], None)]
     )
     metric_fields = ("final_accuracy", "final_test_rmse", "quick")
     for name, curves in groups:

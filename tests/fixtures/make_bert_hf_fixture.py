@@ -1,0 +1,73 @@
+"""Deterministic tiny HF-format BERT checkpoint fixture (+ CoLA-style TSVs).
+
+The reference's flagship flow is: download a pretrained BERT checkpoint,
+point ``run_classifier.py`` at it, fine-tune, evaluate
+(/root/reference/README.md:66-78). The zero-egress container cannot
+download one, so this script builds the smallest faithful stand-in: a
+seeded ``transformers.BertModel`` saved with ``save_pretrained`` (the
+exact on-disk format ``load_hf_checkpoint`` consumes in production), its
+``vocab.txt``, and label-correlated train/dev TSVs in the reference's
+CoLA column layout.
+
+Regenerate with ``python tests/fixtures/make_bert_hf_fixture.py``; the
+output is committed so the evidence run (examples/reproduce_results.py's
+warm-start arm) and tests/test_bert_finetune_chain.py are reproducible
+without re-running this.
+"""
+
+import sys
+from pathlib import Path
+
+FIXTURES = Path(__file__).resolve().parent
+OUT = FIXTURES / "bert_hf_tiny"
+REPO = FIXTURES.parent.parent
+
+# every word the synthetic corpus (examples/bert_finetune.py
+# synthetic_text_task) can emit, so the WordPiece encoder never falls back
+# to [UNK] and the pretrained embedding rows all get gradient traffic
+CORPUS_WORDS = sorted({
+    w
+    for s in (
+        "the cat sat on the mat", "a dog runs fast", "birds fly high",
+        "she reads a good book", "the sun rises early",
+    )
+    for w in s.split()
+})
+
+
+def main():
+    import torch
+    import transformers
+
+    sys.path.insert(0, str(REPO))
+    from examples.bert_finetune import synthetic_text_task
+    from gradaccum_tpu.data.tokenization import SPECIAL_TOKENS
+
+    vocab = SPECIAL_TOKENS + CORPUS_WORDS
+    hf_config = transformers.BertConfig(
+        vocab_size=len(vocab),
+        hidden_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        intermediate_size=128,
+        max_position_embeddings=64,
+        type_vocab_size=2,
+        hidden_dropout_prob=0.1,
+        attention_probs_dropout_prob=0.1,
+    )
+    torch.manual_seed(0)
+    model = transformers.BertModel(hf_config)
+    OUT.mkdir(parents=True, exist_ok=True)
+    model.save_pretrained(OUT)
+    (OUT / "vocab.txt").write_text("\n".join(vocab) + "\n")
+
+    for name, seed, n in (("train.tsv", 11, 2048), ("dev.tsv", 12, 512)):
+        texts, labels = synthetic_text_task(n, seed=seed)
+        rows = [f"{int(l)}\tid{i}\t{t}"
+                for i, (t, l) in enumerate(zip(texts, labels))]
+        (OUT / name).write_text("\n".join(rows) + "\n")
+    print(f"wrote {OUT} (vocab {len(vocab)}, train 2048, dev 512)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""The reference's flagship chain, end to end on committed artifacts:
+pretrained HF-format checkpoint -> warm-start -> fine-tune -> evaluate
+(/root/reference/README.md:66-78). The fixture is the real on-disk format
+``load_hf_checkpoint`` consumes (save_pretrained + vocab.txt), the data
+path is the real TSV loader — only the weights are tiny and seeded
+(tests/fixtures/make_bert_hf_fixture.py regenerates them).
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURE = REPO / "tests" / "fixtures" / "bert_hf_tiny"
+
+
+def test_hf_warmstart_finetune_evaluate_chain(tmp_path):
+    assert (FIXTURE / "model.safetensors").exists(), (
+        "committed fixture missing — regenerate with "
+        "python tests/fixtures/make_bert_hf_fixture.py"
+    )
+    model_dir = tmp_path / "chain"
+    cmd = [
+        sys.executable, str(REPO / "examples" / "bert_finetune.py"),
+        "--hf-checkpoint", str(FIXTURE),
+        "--data-dir", str(FIXTURE),
+        "--seq-len", "32", "--accum-k", "2", "--max-steps", "8",
+        "--model-dir", str(model_dir),
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # single device is enough; 8 would be slower
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          cwd=str(REPO), timeout=540)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # the chain's three acts all leave evidence: warm-start consumed the
+    # checkpoint's vocab (no vocab mismatch error), training logged steps,
+    # and evaluate printed an accuracy
+    assert "eval accuracy" in proc.stdout, proc.stdout[-500:]
+    assert (model_dir / "loss_vs_step.csv").exists()
