@@ -99,10 +99,16 @@ def main(argv=None):
     ap.add_argument("--seqs", type=int, nargs="*", default=SEQS)
     ap.add_argument("--tokens", type=int, default=TOKENS_PER_STEP,
                     help="tokens per step (micro_batch = tokens // seq)")
+    ap.add_argument("--remat-legs", choices=["auto", "none"], default="auto",
+                    help="'auto' adds remat=True legs at the two longest "
+                         "lengths; 'none' skips them (CPU interpret-mode "
+                         "runs, where remat only doubles the wait)")
     args = ap.parse_args(argv)
 
+    from gradaccum_tpu.utils.platform import honor_cpu_platform_request
     from gradaccum_tpu.utils.timing import configure_fast_prng
 
+    honor_cpu_platform_request()  # the axon sitecustomize wins over the env
     configure_fast_prng()
 
     import jax
@@ -115,6 +121,8 @@ def main(argv=None):
     # remat only matters once activations dominate HBM; measure it at the
     # two longest requested lengths
     remat_cutoff = sorted(args.seqs)[-2] if len(args.seqs) > 1 else args.seqs[0]
+    if args.remat_legs == "none":
+        remat_cutoff = float("inf")
     for seq in args.seqs:
         for core in ("dense", "flash"):
             for remat in ([False, True] if seq >= remat_cutoff else [False]):

@@ -104,3 +104,34 @@ def test_bert_noise_floor_not_memorized():
         f"K=1 tail loss {k1['tail_loss_mean']} ~ 0: the arm memorized the "
         "noise; the corpus must be a fresh single-epoch stream"
     )
+
+
+def test_longcontext_evidence_well_formed():
+    """The beyond-reference long-context claim (flash/ring/ulysses) must
+    carry committed measurements: results/longcontext.csv, when present,
+    has both attention cores at every measured length, a device label on
+    every successful row (CPU evidence is fine — it must SAY cpu), and a
+    named error on every failed one. Round-3 verdict: the biggest
+    beyond-reference claim had no committed numbers at all."""
+    path = RESULTS / "longcontext.csv"
+    if not path.exists():
+        pytest.fail(
+            "results/longcontext.csv missing — run "
+            "examples/bench_longcontext.py (reduced CPU sweep is acceptable)"
+        )
+    with open(path, newline="") as f:
+        rows = list(csv.DictReader(f))
+    assert rows, "longcontext.csv is empty"
+    by_seq = {}
+    for r in rows:
+        assert {"seq", "core", "device", "ms_per_step", "error"} <= set(r), r
+        if r["ms_per_step"]:
+            assert r["device"], f"successful row without device label: {r}"
+            assert float(r["ms_per_step"]) > 0, r
+        else:
+            assert r["error"], f"row with neither timing nor error: {r}"
+        by_seq.setdefault(r["seq"], set()).add(r["core"])
+    for seq, cores in by_seq.items():
+        assert {"dense", "flash"} <= cores, (
+            f"seq {seq}: need both attention cores, have {cores}"
+        )
