@@ -91,12 +91,18 @@ def measure(iters, warmup, unrolls, tune_iters):
         "label": rng.integers(0, 2, size=(K * MICRO,)).astype(np.int32),
     }
     sample = jax.tree.map(lambda x: x[:MICRO], batch)
-    params = bundles["dense"].init(jax.random.PRNGKey(0), sample)
 
     schedule = gt.warmup_polynomial_decay(2e-5, num_train_steps=10000,
                                           num_warmup_steps=1000)
     opt = gt.ops.adamw(schedule, weight_decay_rate=0.01)
-    state = scan_init(params, opt)
+
+    def fresh_state():
+        # donation consumes the old buffers, so recovery from a bad
+        # candidate needs a re-init, not a saved reference
+        return scan_init(bundles["dense"].init(jax.random.PRNGKey(0), sample),
+                         opt)
+
+    state = fresh_state()
     stacked = gt.stack_micro_batches(batch, K)
     key = jax.random.PRNGKey(1)
 
@@ -152,7 +158,14 @@ def measure(iters, warmup, unrolls, tune_iters):
         step = build_step(engine, unroll)
         for _ in range(max(warmup, 1)):  # >=1: the drain below needs aux bound
             state, aux = step(state, stacked, key)
-        float(jax.device_get(aux["loss"]))  # drain warmup
+        last_loss = float(jax.device_get(aux["loss"]))  # drain warmup
+        if not np.isfinite(last_loss):
+            # a miscompiled candidate (the flash kernels' first compiled run
+            # happens HERE, unattended) must not win the tune race or taint
+            # the banked artifact
+            raise FloatingPointError(
+                f"{engine}:u{unroll} produced non-finite loss {last_loss}"
+            )
         # host-readback completion + two-point timing: see utils/timing.py for
         # why block_until_ready cannot be trusted on the tunneled backend
         per_step, state = time_device_steps(step, state, (stacked, key), n)
@@ -162,13 +175,28 @@ def measure(iters, warmup, unrolls, tune_iters):
     if len(candidates) > 1:
         best_cand, best = None, float("inf")
         for engine, u in candidates:
-            per_step, state = timed_pass(engine, u, tune_iters, state)
             label = f"{engine}:u{u}"
+            try:
+                per_step, state = timed_pass(engine, u, tune_iters, state)
+            except FloatingPointError as e:
+                # the bad candidate's donated steps polluted the state;
+                # reset and keep racing the others
+                if tune_skipped is None:
+                    tune_skipped = {}
+                tune_skipped[label] = str(e)
+                print(f"[bench] tune {label}: DISQUALIFIED ({e})",
+                      file=sys.stderr)
+                state = fresh_state()
+                continue
             tune_report[label] = round(K * MICRO / per_step, 2)
             print(f"[bench] tune {label}: {tune_report[label]} seq/s",
                   file=sys.stderr)
             if per_step < best:
                 best_cand, best = (engine, u), per_step
+        if best_cand is None:
+            raise RuntimeError(
+                f"every tune candidate produced non-finite loss: {tune_skipped}"
+            )
         engine, unroll = best_cand
     else:
         engine, unroll = candidates[0]
