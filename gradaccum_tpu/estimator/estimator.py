@@ -104,7 +104,9 @@ class Estimator:
         partitioned into stages, the accumulation K doubles as the pipeline
         micro-batch count, ``clip_norm`` applies globally across stages,
         and evaluate/predict merge the trained stages back into the dense
-        tree (so the plain ``model``/``eval_model`` serves them).
+        tree (so the plain ``model``/``eval_model`` serves them). Requires
+        ``accum.first_step_quirk=False``: the quirk is a streaming-mode
+        semantic the scan-based pipeline schedule cannot honor.
 
         ``zero1``: shard the optimizer moments over the mesh's ``data``
         axis (:mod:`parallel.zero` — per-device optimizer memory drops by

@@ -135,3 +135,16 @@ def test_longcontext_evidence_well_formed():
         assert {"dense", "flash"} <= cores, (
             f"seq {seq}: need both attention cores, have {cores}"
         )
+
+
+def test_hf_warmstart_chain_evidence():
+    """The warm-start arm proves the flagship chain LEARNED, not just ran:
+    the synthetic task is linearly separable and dev is a disjoint draw,
+    so anything under 0.9 accuracy means the warm-start or data path broke
+    (untrained floor is ~0.5)."""
+    summary = _summary()
+    entry = summary["runs"].get("bert_cola_hf_warmstart")
+    if not entry or entry.get("quick"):
+        pytest.skip("no full warm-start arm committed")
+    assert entry.get("final_accuracy") is not None, entry
+    assert entry["final_accuracy"] >= 0.9, entry
