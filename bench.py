@@ -351,6 +351,17 @@ def run_orchestrator(args):
         })
         return 1
 
+    if not banked:
+        # Provisional diagnostic line NOW: a late TPU measurement can run
+        # into the driver kill, and last-parsable-line semantics mean a
+        # later success simply overrides this. Never be line-less again.
+        _emit({
+            "metric": "bert_small_seq128_effbatch32_train_throughput",
+            "value": 0.0, "unit": "seq/s", "vs_baseline": 0.0, "mfu": None,
+            "error": "cpu-first failed; tpu upgrade still pending",
+            "bench_attempts": list(attempts),
+        })
+
     # --- Act 2: spend the remaining window trying to upgrade to TPU. ---
     deadline = start + wait_budget
     probe_failures = 0      # consecutive-failure collapse so 8 probes != 8 lines
