@@ -1,12 +1,25 @@
-"""Long-context attention scaling on one TPU chip: dense vs Pallas flash.
+"""Long-context attention scaling: dense vs flash vs ring vs ulysses.
 
 The reference caps sequence length at a static 128 (--max_seq_length=128,
 /root/reference/README.md:72) — long context is one of this framework's
 beyond-reference capabilities, and this benchmark is its evidence. It trains
-BERT-Small (fwd+bwd+AdamW, bf16) across sequence lengths with the token
-count per step held constant, once with the dense [S,S] attention core and
-once with the fused online-softmax Pallas kernel
-(ops/flash_attention.py), optionally with per-layer rematerialization.
+BERT-Small (fwd+bwd+AdamW, bf16) across sequence lengths with four
+attention cores:
+
+- ``dense``  — the [S,S] materialized core, single device;
+- ``flash``  — the fused online-softmax Pallas kernel
+  (ops/flash_attention.py), single device (interpret-mode off-TPU, so its
+  CPU timings are a correctness artifact, not a speed claim);
+- ``ring``   — sequence-parallel blockwise attention over a ``seq`` mesh
+  axis with ppermute K/V hops (parallel/ring_attention.py), run on every
+  available device (the 8-device virtual CPU mesh in this container);
+- ``ulysses``— all_to_all head-parallel attention (parallel/ulysses.py),
+  same mesh.
+
+Single-device legs also record XLA's compiled peak temp allocation
+(``peak_temp_mb`` from ``compiled.memory_analysis()``) — the dense core's
+O(S^2) activation scaling vs flash's O(S) is the memory story that
+motivates the sharded cores.
 
 Timing uses host readbacks + two-point measurement (see bench.py: the
 tunneled backend's block_until_ready can return early).
@@ -14,6 +27,8 @@ tunneled backend's block_until_ready can return early).
 Writes results/longcontext.csv and prints one JSON line per config.
 
 Usage: python examples/bench_longcontext.py [--out results/longcontext.csv]
+(set XLA_FLAGS=--xla_force_host_platform_device_count=8 for the ring /
+ulysses legs off-TPU; they error-row cleanly on a single device)
 """
 
 import argparse
@@ -29,66 +44,154 @@ TOKENS_PER_STEP = 16384
 VOCAB = 30522
 
 
-def measure_one(seq, core, remat, iters, tokens_per_step=TOKENS_PER_STEP):
-    import jax
-    import jax.numpy as jnp
+def _example_text_batch(micro, seq):
     import numpy as np
 
-    import gradaccum_tpu as gt
-    from gradaccum_tpu.models.bert import (
-        BertConfig, bert_classifier_bundle, dense_attention,
-    )
-    from gradaccum_tpu.ops.accumulation import scan_init
-    from gradaccum_tpu.ops.flash_attention import flash_attention
-
-    micro = max(1, tokens_per_step // seq)
-    cfg = BertConfig.small(
-        vocab_size=VOCAB, dtype=jnp.bfloat16, remat=remat,
-        max_position_embeddings=max(512, seq),
-        hidden_dropout=0.0, attention_dropout=0.0,
-    )
-    attention_fn = flash_attention if core == "flash" else dense_attention
-    bundle = bert_classifier_bundle(cfg, num_classes=2,
-                                    attention_fn=attention_fn)
-
     rng = np.random.default_rng(0)
-    batch = {
+    return {
         "input_ids": rng.integers(0, VOCAB, size=(micro, seq)).astype(np.int32),
         "input_mask": np.ones((micro, seq), np.int32),
         "segment_ids": np.zeros((micro, seq), np.int32),
         "label": rng.integers(0, 2, size=(micro,)).astype(np.int32),
     }
-    params = bundle.init(jax.random.PRNGKey(0), batch)
+
+
+def _model_cfg(seq, remat):
+    import jax.numpy as jnp
+
+    from gradaccum_tpu.models.bert import BertConfig
+
+    return BertConfig.small(
+        vocab_size=VOCAB, dtype=jnp.bfloat16, remat=remat,
+        max_position_embeddings=max(512, seq),
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+
+
+def _timed_row(step, bundle, batch, iters, device, seq, core, remat,
+               peak_temp_mb):
+    """Shared tail of every leg: init state, warm up, two-point time, row."""
+    import jax
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.ops.accumulation import scan_init
+    from gradaccum_tpu.utils.timing import time_device_steps
+
     opt = gt.ops.adamw(gt.warmup_polynomial_decay(2e-5, 10000, 1000),
                        weight_decay_rate=0.01)
-    state = scan_init(params, opt)
-    step = jax.jit(
-        gt.accumulate_scan(
-            bundle.loss, opt, gt.GradAccumConfig(num_micro_batches=1),
-            needs_rng=True,
-        ),
-        donate_argnums=0,
-    )
+    state = scan_init(bundle.init(jax.random.PRNGKey(0), batch), opt)
+    step = step(bundle, opt)
     stacked = gt.stack_micro_batches(batch, 1)
     key = jax.random.PRNGKey(1)
+    if peak_temp_mb == "from_aot":  # AOT-compile so XLA's memory stats exist
+        step = step.lower(state, stacked, key).compile()
+        peak_temp_mb = None
+        try:
+            mem = step.memory_analysis()
+            if mem is not None and getattr(mem, "temp_size_in_bytes", 0):
+                peak_temp_mb = round(mem.temp_size_in_bytes / 2**20, 1)
+        except Exception:
+            pass
 
     for _ in range(3):
         state, aux = step(state, stacked, key)
     float(jax.device_get(aux["loss"]))
 
-    from gradaccum_tpu.utils.timing import time_device_steps
-
     per_step, state = time_device_steps(step, state, (stacked, key), iters)
-    dev = jax.devices()[0]
+    micro = batch["label"].shape[0]
     return {
-        "device": f"{dev.device_kind} ({dev.platform})",
+        "device": device,
         "seq": seq,
         "core": core,
         "remat": remat,
         "micro_batch": micro,
         "ms_per_step": round(per_step * 1e3, 3),
         "tokens_per_sec": round(micro * seq / per_step, 1),
+        "peak_temp_mb": peak_temp_mb,
+        "iters": iters,
     }
+
+
+def measure_one(seq, core, remat, iters, tokens_per_step=TOKENS_PER_STEP):
+    """Single-device legs (dense / flash), with a compiled-memory reading.
+
+    The AOT lower+compile gives both the callable used for timing AND
+    XLA's peak-temp-allocation stats — the activation-memory scaling
+    evidence (dense O(S^2) vs flash O(S))."""
+    import jax
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.models.bert import bert_classifier_bundle, dense_attention
+    from gradaccum_tpu.ops.flash_attention import flash_attention
+
+    micro = max(1, tokens_per_step // seq)
+    cfg = _model_cfg(seq, remat)
+    attention_fn = flash_attention if core == "flash" else dense_attention
+    bundle = bert_classifier_bundle(cfg, num_classes=2,
+                                    attention_fn=attention_fn)
+
+    def build_step(bundle, opt):
+        return jax.jit(
+            gt.accumulate_scan(
+                bundle.loss, opt, gt.GradAccumConfig(num_micro_batches=1),
+                needs_rng=True,
+            ),
+            donate_argnums=0,
+        )
+
+    dev = jax.devices()[0]
+    return _timed_row(
+        build_step, bundle, _example_text_batch(micro, seq), iters,
+        f"{dev.device_kind} ({dev.platform})", seq, core, remat,
+        peak_temp_mb="from_aot",
+    )
+
+
+def measure_sp(seq, core, iters, tokens_per_step=TOKENS_PER_STEP):
+    """Sequence-parallel legs (ring / ulysses) over a (data=1, seq=N) mesh.
+
+    The token dimension is sharded N ways, so each device holds S/N tokens
+    of activations — the long-context scaling mechanism itself, measured
+    end-to-end (fwd+bwd+AdamW) exactly like the single-device legs. No
+    peak_temp_mb: the sharded step jits inside make_dp_sp_train_step."""
+    import jax
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.models.bert import bert_classifier_bundle
+    from gradaccum_tpu.parallel.mesh import make_mesh
+    from gradaccum_tpu.parallel.ring_attention import make_ring_attention_fn
+    from gradaccum_tpu.parallel.sp import make_dp_sp_train_step
+    from gradaccum_tpu.parallel.ulysses import make_ulysses_attention_fn
+
+    n = len(jax.devices())
+    if n < 2:
+        raise RuntimeError(
+            f"{core} needs a multi-device mesh; only {n} device present "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
+    if seq % n:
+        raise RuntimeError(f"seq {seq} not divisible by {n} seq ranks")
+    mesh = make_mesh(data=1, seq=n, devices=jax.devices())
+
+    micro = max(1, tokens_per_step // seq)
+    cfg = _model_cfg(seq, remat=False)
+    attention_fn = (make_ulysses_attention_fn("seq") if core == "ulysses"
+                    else make_ring_attention_fn("seq"))
+    bundle = bert_classifier_bundle(cfg, num_classes=2,
+                                    attention_fn=attention_fn, seq_axis="seq")
+
+    def build_step(bundle, opt):
+        return make_dp_sp_train_step(
+            bundle.loss, opt, gt.GradAccumConfig(num_micro_batches=1),
+            mesh, needs_rng=True,
+        )
+
+    dev = jax.devices()[0]
+    return _timed_row(
+        build_step, bundle, _example_text_batch(micro, seq), iters,
+        f"{dev.device_kind} ({dev.platform}) x{n}", seq, core, False,
+        peak_temp_mb=None,
+    )
 
 
 def main(argv=None):
@@ -124,11 +227,22 @@ def main(argv=None):
     if args.remat_legs == "none":
         remat_cutoff = float("inf")
     for seq in args.seqs:
-        for core in ("dense", "flash"):
-            for remat in ([False, True] if seq >= remat_cutoff else [False]):
+        for core in ("dense", "flash", "ring", "ulysses"):
+            # flash interpret-mode steps take minutes at long lengths on
+            # CPU; shrink its sample there rather than dropping the length
+            # (every row records its own iters, so the reduction is visible)
+            iters = (max(2, args.iters // 5)
+                     if core == "flash" and seq >= 2048 else args.iters)
+            sp_core = core in ("ring", "ulysses")
+            remats = ([False] if sp_core
+                      else [False, True] if seq >= remat_cutoff else [False])
+            for remat in remats:
                 label = f"seq={seq} core={core} remat={remat}"
                 try:
-                    row = measure_one(seq, core, remat, args.iters, args.tokens)
+                    if sp_core:
+                        row = measure_sp(seq, core, iters, args.tokens)
+                    else:
+                        row = measure_one(seq, core, remat, iters, args.tokens)
                 except Exception as e:  # OOM at long dense lengths is data
                     row = {"device": None, "seq": seq, "core": core, "remat": remat,
                            "micro_batch": max(1, args.tokens // seq),
@@ -142,7 +256,7 @@ def main(argv=None):
     out = Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
     fields = ["device", "seq", "core", "remat", "micro_batch", "ms_per_step",
-              "tokens_per_sec", "error"]
+              "tokens_per_sec", "peak_temp_mb", "iters", "error"]
     with open(out, "w", newline="") as f:
         w = csv.DictWriter(f, fieldnames=fields)
         w.writeheader()

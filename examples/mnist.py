@@ -33,12 +33,22 @@ def main(argv=None):
     parser.add_argument("--variant", choices=sorted(VARIANTS), default="02")
     parser.add_argument("--lr", type=float, default=1e-4)
     parser.add_argument("--eval-batch", type=int, default=10000)  # 02:128
+    parser.add_argument(
+        "--label-noise", type=float, default=0.0,
+        help="fraction of TRAIN labels flipped to a uniform other class; "
+             "with a --train-size covering the whole sample budget this "
+             "gives the equivalence matrix a nonzero entropy floor "
+             "(~0.545 at 0.10) that no arm can memorize below")
+    parser.add_argument(
+        "--train-size", type=int, default=None,
+        help="synthetic train-set size (e.g. max_steps x effective batch "
+             "for a fresh single-epoch stream); ignored with --data-dir")
     args = parser.parse_args(argv)
 
     import jax
 
     import gradaccum_tpu as gt
-    from gradaccum_tpu.data.mnist import load
+    from gradaccum_tpu.data.mnist import flip_labels, load
     from gradaccum_tpu.models.mnist_cnn import mnist_cnn_bundle
     from gradaccum_tpu.parallel.mesh import data_parallel_mesh
 
@@ -51,9 +61,13 @@ def main(argv=None):
             print(f"[warn] only {n} device(s); running variant on {n}-wide mesh")
         mesh = data_parallel_mesh(n)
 
-    data = load(args.data_dir)
+    data = load(args.data_dir, num_train=args.train_size)
     train_images, train_labels = data["train"]
     test_images, test_labels = data["test"]
+    if args.label_noise > 0:
+        train_labels = flip_labels(train_labels, args.label_noise)
+        print(f"[mnist] label noise {args.label_noise}: entropy floor "
+              "applies to the TRAIN loss curve (eval labels stay clean)")
 
     est = gt.Estimator(
         mnist_cnn_bundle(),

@@ -35,12 +35,18 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-# (name, script args, reference step count)
+# (name, script args, reference step count). Every arm consumes exactly
+# 300,000 samples (effective batch 200 x 1,500 updates); --train-size
+# 300000 makes that a fresh single-epoch stream and --label-noise 0.10
+# sets a ~0.545 entropy floor no arm can memorize below, so the four
+# curves agreeing at the floor is a non-vacuous equivalence claim
+# (round-4 verdict, Weak #4; reference README.md:135-139).
+MNIST_NOISE = ["--label-noise", "0.10", "--train-size", "300000"]
 MNIST_RUNS = [
-    ("mnist_01_1w_b200_k1", ["--variant", "01", "--max-steps", "1500"]),
-    ("mnist_02_1w_b100_k2", ["--variant", "02", "--max-steps", "3000"]),
-    ("mnist_03_2w_b100_k1", ["--variant", "03", "--max-steps", "1500"]),
-    ("mnist_04_2w_b50_k2", ["--variant", "04", "--max-steps", "3000"]),
+    ("mnist_01_1w_b200_k1", ["--variant", "01", "--max-steps", "1500"] + MNIST_NOISE),
+    ("mnist_02_1w_b100_k2", ["--variant", "02", "--max-steps", "3000"] + MNIST_NOISE),
+    ("mnist_03_2w_b100_k1", ["--variant", "03", "--max-steps", "1500"] + MNIST_NOISE),
+    ("mnist_04_2w_b50_k2", ["--variant", "04", "--max-steps", "3000"] + MNIST_NOISE),
 ]
 # --train-size 25600 = 3200 steps x micro-batch 8: a fresh single-epoch
 # stream. Both arms consume the SAME budget (3,200 micro-steps), and neither
@@ -170,8 +176,12 @@ def main(argv=None):
     ap.add_argument("--out", default=str(REPO / "results"))
     ap.add_argument("--quick", action="store_true", help="10x fewer steps (smoke)")
     ap.add_argument(
-        "--only", choices=["all", "mnist", "bert", "housing"], default="all",
-        help="rerun one group; other groups' curves reload from --out",
+        "--only",
+        choices=["all", "mnist", "bert", "warmstart", "housing"],
+        default="all",
+        help="rerun one group; other groups' curves reload from --out "
+             "('warmstart' = just the HF warm-start chain arm, so the two "
+             "multi-hour K4/K1 arms aren't re-run to refresh it)",
     )
     ap.add_argument(
         "--run-timeout", type=int, default=1800,
@@ -216,7 +226,9 @@ def main(argv=None):
         ran(name, acc)
 
     for name, extra in BERT_RUNS + [BERT_HF_RUN]:
-        if args.only not in ("all", "bert"):
+        is_warmstart = name == BERT_HF_RUN[0]
+        wanted = ("all", "bert", "warmstart") if is_warmstart else ("all", "bert")
+        if args.only not in wanted:
             continue
         model_dir, acc = run_one("bert_finetune.py", name, extra, run_root,
                                  args.quick, cpu_mesh=False,

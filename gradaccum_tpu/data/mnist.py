@@ -104,14 +104,38 @@ def synthetic(
     return {"train": make(num_train, seed + 1), "test": make(num_test, seed + 2)}
 
 
+def flip_labels(
+    labels: np.ndarray, frac: float, num_classes: int = 10,
+    seed: int = 19830610,
+) -> np.ndarray:
+    """Symmetric label noise: flip ``frac`` of labels to a uniform OTHER class.
+
+    Gives the convergence-equivalence matrix a nonzero entropy floor — with
+    clean synthetic data all four effective-batch-200 arms drive loss to ~0
+    and agree vacuously (round-4 verdict, Weak #4); with a fresh
+    single-epoch stream plus 10% flips the optimal loss is
+    ``H(0.9, 0.1/9 x 9) ~ 0.545`` and the arms' agreement at that floor is
+    the reference's Loss_Step_multiWorker.png claim with teeth."""
+    if frac <= 0:
+        return labels
+    rng = np.random.default_rng(seed + 7)
+    flip = rng.random(labels.shape[0]) < frac
+    offset = rng.integers(1, num_classes, size=labels.shape[0])
+    return np.where(flip, (labels + offset) % num_classes, labels).astype(
+        labels.dtype)
+
+
 def load(
     data_dir: Optional[str] = None,
     synthetic_fallback: bool = True,
+    num_train: Optional[int] = None,
 ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
     """Load MNIST as ``{"train": (images, labels), "test": ...}``.
 
     Mirrors ``mnist_dataset.load()`` (mnist_dataset.py:4-26) including the
     image/label zip; falls back to :func:`synthetic` when files are missing.
+    ``num_train`` sizes the synthetic fallback (e.g. a fresh single-epoch
+    stream covering a whole run's sample budget); ignored for real files.
     """
     if data_dir is not None:
         found = {}
@@ -127,6 +151,8 @@ def load(
             raise FileNotFoundError(f"MNIST files for splits {missing} not in {data_dir}")
     if not synthetic_fallback:
         raise FileNotFoundError("no data_dir given and synthetic_fallback=False")
+    if num_train is not None:
+        return synthetic(num_train=num_train)
     return synthetic()
 
 
