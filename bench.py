@@ -34,6 +34,7 @@ legacy engine pin.
 import argparse
 import json
 import os
+import socket
 import subprocess
 import sys
 import time
@@ -124,16 +125,19 @@ def measure(iters, warmup, unrolls, tune_iters):
         print(f"[bench] demoting GRADACCUM_ENGINE={pin} to {demoted} off-TPU: "
               f"{FLASH_SKIP_REASON}", file=sys.stderr)
         pin = demoted
+    tune_skipped = None
     if pin is not None:
         engines = (pin,)
     elif len(unrolls) == 1 and not on_tpu:
         engines = ("dense",)  # the quick CPU pass: no tune racing
     else:
         engines = ENGINES if on_tpu else ("dense", "sparse")
-    tune_skipped = (
-        {"flash": FLASH_SKIP_REASON, "flash_sparse": FLASH_SKIP_REASON}
-        if not on_tpu else None
-    )
+        if not on_tpu:
+            # only here was a race actually run with flash excluded; the
+            # pinned/quick branches never race, so recording a "skip"
+            # there would claim a tune that didn't happen
+            tune_skipped = {"flash": FLASH_SKIP_REASON,
+                            "flash_sparse": FLASH_SKIP_REASON}
 
     def build_step(engine, unroll):
         if (engine, unroll) not in steps:  # cache jitted fns: the winner's
@@ -245,7 +249,7 @@ def run_worker(args):
     if unrolls is None:
         unrolls = [max(1, int(u)) for u in args.unrolls.split(",")]
     result = measure(args.iters, args.warmup, unrolls, args.tune_iters)
-    print(json.dumps(result))
+    _emit(result)  # routes through the same host/nproc stamping
 
 
 def _probe_backend(env, timeout_s=120):
@@ -300,6 +304,11 @@ def _run_measurement(label, env, worker_args, timeout_s):
 
 
 def _emit(result):
+    # host identity on every line: CPU numbers are only comparable
+    # round-over-round with the core count attached (round-4 verdict —
+    # the r02->r04 3.2x "regression" was an 8-core box vs a 1-core box)
+    result.setdefault("nproc", os.cpu_count())
+    result.setdefault("host", socket.gethostname())
     print(json.dumps(result))
     sys.stdout.flush()
 
@@ -426,7 +435,13 @@ def run_orchestrator(args):
         elapsed = time.monotonic() - t_probe
         time.sleep(min(max(probe_interval - elapsed, 0), remaining))
     flush_probe_failures()
-    if not tpu_declined:
+    if measurement_failures >= 3:
+        # the TPU was live and measurements RAN - they just failed; saying
+        # "never measured" here would misdescribe the outage mode
+        attempts.append("tpu measurements failed 3x; giving up on upgrade")
+        print(f"[bench] tpu measurements failed 3x; CPU line "
+              f"{'stands' if banked else 'MISSING'}", file=sys.stderr)
+    elif not tpu_declined:
         attempts.append(
             f"tpu never measured within {wait_budget / 60:.0f}min window"
         )

@@ -226,13 +226,16 @@ def main(argv=None):
     remat_cutoff = sorted(args.seqs)[-2] if len(args.seqs) > 1 else args.seqs[0]
     if args.remat_legs == "none":
         remat_cutoff = float("inf")
+    on_tpu = dev.platform == "tpu"
     for seq in args.seqs:
         for core in ("dense", "flash", "ring", "ulysses"):
-            # flash interpret-mode steps take minutes at long lengths on
-            # CPU; shrink its sample there rather than dropping the length
-            # (every row records its own iters, so the reduction is visible)
+            # interpret-mode flash steps take minutes at long lengths OFF
+            # TPU; shrink its sample there rather than dropping the length
+            # (every row records its own iters, so the reduction is
+            # visible). On TPU the compiled kernel is fast - full sample.
             iters = (max(2, args.iters // 5)
-                     if core == "flash" and seq >= 2048 else args.iters)
+                     if core == "flash" and seq >= 2048 and not on_tpu
+                     else args.iters)
             sp_core = core in ("ring", "ulysses")
             remats = ([False] if sp_core
                       else [False, True] if seq >= remat_cutoff else [False])

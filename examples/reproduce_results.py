@@ -79,6 +79,19 @@ BERT_HF_RUN = (
 HOUSING_RUN = ("housing_b59_k3", ["--max-steps", "3000"])
 
 
+def _drop_flags(extra, flags):
+    """Remove ``--flag value`` pairs from an args list."""
+    out, skip = [], False
+    for a in extra:
+        if skip:
+            skip = False
+        elif a in flags:
+            skip = True
+        else:
+            out.append(a)
+    return out
+
+
 def run_one(script, name, extra, run_root, quick, cpu_mesh=True,
             run_timeout=1800):
     """``cpu_mesh``: force the 8-device virtual CPU mesh (required for the
@@ -189,6 +202,17 @@ def main(argv=None):
              "CPU-only machines; the default assumes accelerator-speed runs "
              "and exists to catch hung TPU-tunnel backend inits)",
     )
+    ap.add_argument(
+        "--mnist-data-dir", default=None,
+        help="real MNIST idx-gz directory: every matrix arm trains on it "
+             "instead of the synthetic stand-in, reproducing the "
+             "reference's Loss_Step_multiWorker.png floors directly",
+    )
+    ap.add_argument(
+        "--bert-data-dir", default=None,
+        help="real CoLA train.tsv/dev.tsv directory for the K4-vs-K1 arms "
+             "(the warm-start arm keeps its committed fixture checkpoint)",
+    )
     args = ap.parse_args(argv)
 
     out = Path(args.out)
@@ -219,6 +243,8 @@ def main(argv=None):
     for name, extra in MNIST_RUNS:
         if args.only not in ("all", "mnist"):
             continue
+        if args.mnist_data_dir:
+            extra = extra + ["--data-dir", args.mnist_data_dir]
         model_dir, acc = run_one("mnist.py", name, extra, run_root,
                                  args.quick, run_timeout=args.run_timeout)
         shutil.copy(os.path.join(model_dir, "loss_vs_step.csv"),
@@ -230,6 +256,10 @@ def main(argv=None):
         wanted = ("all", "bert", "warmstart") if is_warmstart else ("all", "bert")
         if args.only not in wanted:
             continue
+        if args.bert_data_dir and not is_warmstart:
+            # real data replaces both the synthetic corpus and its sizing
+            extra = _drop_flags(extra, ("--train-size", "--label-noise"))
+            extra = extra + ["--data-dir", args.bert_data_dir]
         model_dir, acc = run_one("bert_finetune.py", name, extra, run_root,
                                  args.quick, cpu_mesh=False,
                                  run_timeout=args.run_timeout)

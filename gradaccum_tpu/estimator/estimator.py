@@ -61,6 +61,18 @@ class Estimator:
     the TPU-native hot path). ``mesh``: optional ``jax.sharding.Mesh`` with a
     ``data`` axis for data-parallel training (the reference's
     MultiWorkerMirroredStrategy slot, 03:76).
+
+    ``pipeline`` callers note: the default ``GradAccumConfig`` keeps
+    ``first_step_quirk=True`` (the reference's step-0 apply,
+    optimization.py:91), but that is a streaming-mode semantic the scan
+    path cannot express, so pipeline mode refuses it —
+
+        Estimator(model, opt,
+                  GradAccumConfig(num_micro_batches=4, first_step_quirk=False),
+                  config, mesh=mesh, pipeline=pp_spec)
+
+    The explicit ``False`` acknowledges the schedule starts at a full
+    K-cycle instead of the reference's under-scaled first update.
     """
 
     def __init__(

@@ -6,8 +6,10 @@ point ``run_classifier.py`` at it, fine-tune, evaluate
 download one, so this script builds the smallest faithful stand-in: a
 seeded ``transformers.BertModel`` saved with ``save_pretrained`` (the
 exact on-disk format ``load_hf_checkpoint`` consumes in production), its
-``vocab.txt``, and label-correlated train/dev TSVs in the reference's
-CoLA column layout.
+``vocab.txt``, and label-correlated train/dev TSVs in this repo's
+``load_tsv`` layout (label in the first column, sentence in the last —
+NOT the reference's CoLA layout, which puts the label in column 1 of 4
+and the sentence in column 3).
 
 Regenerate with ``python tests/fixtures/make_bert_hf_fixture.py``; the
 output is committed so the evidence run (examples/reproduce_results.py's

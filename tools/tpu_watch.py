@@ -115,13 +115,16 @@ def main():
         f"probe every {PROBE_INTERVAL_S}s, timeout {PROBE_TIMEOUT_S}s)")
     t0 = time.time()
     n_up = n_down = 0
+    ran_revival = False  # the workload is hours; run it at most once
     while time.time() - t0 < TOTAL_WINDOW_S:
         got = probe()
         if got == "tpu":
             n_up += 1
             log(f"probe: TPU UP (probe #{n_up + n_down})")
-            on_revival()
-            log("watcher: revival work done; continuing low-rate watch")
+            if not ran_revival:
+                on_revival()
+                ran_revival = True
+                log("watcher: revival work done; continuing low-rate watch")
             time.sleep(1800)
         else:
             n_down += 1
