@@ -175,9 +175,8 @@ def measure(iters, warmup, unrolls, tune_iters):
         per_step, state = time_device_steps(step, state, (stacked, key), n)
         return per_step, state
 
-    candidates = [(e, u) for e in engines for u in unrolls]
-    if len(candidates) > 1:
-        best_cand, best = None, float("inf")
+    def race(candidates, state, best_cand=None, best=float("inf")):
+        nonlocal tune_skipped
         for engine, u in candidates:
             label = f"{engine}:u{u}"
             try:
@@ -197,13 +196,26 @@ def measure(iters, warmup, unrolls, tune_iters):
                   file=sys.stderr)
             if per_step < best:
                 best_cand, best = (engine, u), per_step
+        return best_cand, best, state
+
+    if len(engines) > 1 or len(unrolls) > 1:
+        # Greedy two-stage tune: race engines at the first unroll, then the
+        # remaining unrolls for the winning engine only — 4+2 candidate
+        # compiles instead of the 4x3=12 full cross product, which blew the
+        # driver's window through the high-latency tunnel (round 5).
+        best_cand, best, state = race(
+            [(e, unrolls[0]) for e in engines], state
+        )
         if best_cand is None:
             raise RuntimeError(
                 f"every tune candidate produced non-finite loss: {tune_skipped}"
             )
+        best_cand, best, state = race(
+            [(best_cand[0], u) for u in unrolls[1:]], state, best_cand, best
+        )
         engine, unroll = best_cand
     else:
-        engine, unroll = candidates[0]
+        engine, unroll = engines[0], unrolls[0]
 
     per_step, state = timed_pass(engine, unroll, iters, state)
 
@@ -378,6 +390,7 @@ def run_orchestrator(args):
     measurement_failures = 0
     probe_n = 0
     tpu_declined = False    # live TPU seen, but too late in the window
+    tpu_banked_any = False  # a tpu-quick line was emitted and stands
 
     def flush_probe_failures():
         nonlocal probe_failures
@@ -415,6 +428,43 @@ def run_orchestrator(args):
                 )
                 tpu_declined = True
                 break
+            # Bank a QUICK pinned-engine TPU line first — same philosophy
+            # as cpu-first. Round-5 evidence: the full 12-candidate tune
+            # race (4 engines x 3 unrolls, each with its own compile)
+            # through the tunnel blew a ~24-min budget and left only the
+            # CPU line. A dense:u1 pass is one compile + a short timed
+            # run; the full race then runs as an optional upgrade whose
+            # success simply emits a later (overriding) line.
+            tpu_banked = False
+            if os.environ.get("BENCH_TPU_QUICK", "1") != "0":
+                quick_env = dict(os.environ, GRADACCUM_ENGINE="dense")
+                result, detail = _run_measurement(
+                    "tpu-quick", quick_env,
+                    ["--iters", "20", "--warmup", "2", "--unrolls", "1"],
+                    timeout_s=min(600, max(window_left, 300)),
+                )
+                if result is not None and "tpu" not in result.get("device", ""):
+                    # the probe saw TPU live but the child fell back to CPU
+                    # in-process (fast init failure) — banking THIS as the
+                    # tpu upgrade would mislabel a CPU number
+                    attempts.append(
+                        f"tpu-quick ran on {result.get('device')}; not banked"
+                    )
+                    result = None
+                    detail = "fell back to cpu"
+                if result is not None:
+                    result["bench_attempts"] = attempts + ["tpu-quick: ok"]
+                    result["bench_wait_min"] = round(mins, 1)
+                    result["tpu_quick"] = True
+                    _emit(result)
+                    tpu_banked = tpu_banked_any = True
+                    attempts.append("tpu-quick: ok (banked)")
+                else:
+                    attempts.append(f"tpu-quick: {detail}")
+                window_left = start + total_window - time.monotonic()
+                if window_left < 300:
+                    tpu_declined = not tpu_banked
+                    break
             result, detail = _run_measurement(
                 f"measure-{measurement_failures + 1}", dict(os.environ),
                 ["--iters", str(args.iters), "--warmup", str(args.warmup),
@@ -422,6 +472,15 @@ def run_orchestrator(args):
                  str(args.tune_iters)],
                 timeout_s=min(measure_timeout, max(window_left, 300)),
             )
+            if result is not None and "tpu" not in result.get("device", ""):
+                # same in-process-CPU-fallback mislabel the quick path
+                # guards: a CPU-labeled "upgrade" must not override the
+                # banked CPU (or real TPU) line
+                attempts.append(
+                    f"measurement ran on {result.get('device')}; discarded"
+                )
+                result = None
+                detail = "fell back to cpu"
             if result is not None:
                 result["bench_attempts"] = attempts + ["measurement: ok"]
                 result["bench_wait_min"] = round(mins, 1)
@@ -429,6 +488,10 @@ def run_orchestrator(args):
                 return 0
             measurement_failures += 1
             attempts.append(f"measurement {measurement_failures}: {detail}")
+            if tpu_banked:
+                # the quick TPU line stands; don't let a late retry risk
+                # overwriting it with nothing inside the driver kill window
+                return 0
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             break
@@ -441,13 +504,16 @@ def run_orchestrator(args):
         attempts.append("tpu measurements failed 3x; giving up on upgrade")
         print(f"[bench] tpu measurements failed 3x; CPU line "
               f"{'stands' if banked else 'MISSING'}", file=sys.stderr)
-    elif not tpu_declined:
+    elif not tpu_declined and not tpu_banked_any:
         attempts.append(
             f"tpu never measured within {wait_budget / 60:.0f}min window"
         )
         print(f"[bench] no TPU within the window; CPU line "
               f"{'stands' if banked else 'MISSING'}", file=sys.stderr)
-    if banked:
+    if banked or tpu_banked_any:
+        # a good line (CPU and/or quick-TPU) already stands; the diagnostic
+        # fallthrough below would override it under last-parsable-line
+        # semantics
         return 0
     # CPU failed earlier AND no TPU. Emit the diagnostic line FIRST (a later
     # success line would override it under last-parsable-line semantics), so

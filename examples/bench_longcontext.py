@@ -206,6 +206,17 @@ def main(argv=None):
                     help="'auto' adds remat=True legs at the two longest "
                          "lengths; 'none' skips them (CPU interpret-mode "
                          "runs, where remat only doubles the wait)")
+    ap.add_argument("--cores", nargs="*",
+                    default=["dense", "flash", "ring", "ulysses"],
+                    choices=["dense", "flash", "ring", "ulysses"],
+                    help="which attention cores to measure — lets the "
+                         "single-device legs run on the real (single-chip) "
+                         "TPU and the sharded legs on the 8-device CPU "
+                         "mesh, merged via --append")
+    ap.add_argument("--append", action="store_true",
+                    help="merge into an existing --out instead of "
+                         "overwriting: rows whose (seq, core, remat) is "
+                         "re-measured are replaced, others kept")
     args = ap.parse_args(argv)
 
     from gradaccum_tpu.utils.platform import honor_cpu_platform_request
@@ -228,7 +239,7 @@ def main(argv=None):
         remat_cutoff = float("inf")
     on_tpu = dev.platform == "tpu"
     for seq in args.seqs:
-        for core in ("dense", "flash", "ring", "ulysses"):
+        for core in args.cores:
             # interpret-mode flash steps take minutes at long lengths OFF
             # TPU; shrink its sample there rather than dropping the length
             # (every row records its own iters, so the reduction is
@@ -260,6 +271,12 @@ def main(argv=None):
     out.parent.mkdir(parents=True, exist_ok=True)
     fields = ["device", "seq", "core", "remat", "micro_batch", "ms_per_step",
               "tokens_per_sec", "peak_temp_mb", "iters", "error"]
+    if args.append and out.exists():
+        fresh = {(str(r["seq"]), r["core"], str(r.get("remat"))) for r in rows}
+        with open(out, newline="") as f:
+            kept = [r for r in csv.DictReader(f)
+                    if (r["seq"], r["core"], r["remat"]) not in fresh]
+        rows = kept + rows
     with open(out, "w", newline="") as f:
         w = csv.DictWriter(f, fieldnames=fields)
         w.writeheader()

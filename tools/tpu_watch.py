@@ -84,7 +84,13 @@ def on_revival():
                     last_json = json.loads(ln)
                 except json.JSONDecodeError:
                     pass
-        if last_json is not None:
+        if last_json is not None and "tpu" not in str(last_json.get("device", "")):
+            # bench banked only its CPU line (TPU measurement failed or the
+            # child fell back to CPU) — filing that as the TPU artifact
+            # would mislabel a CPU number (round-5 code review)
+            log(f"REVIVAL: bench's last line is {last_json.get('device')!r}, "
+                "not a TPU measurement; BENCH_TPU.json not written")
+        elif last_json is not None:
             with open(REPO / "BENCH_TPU.json", "w") as f:
                 json.dump(last_json, f, indent=2)
             log(f"REVIVAL: wrote BENCH_TPU.json value={last_json.get('value')} "
