@@ -113,15 +113,27 @@ def run_one(script, name, extra, run_root, quick, cpu_mesh=True,
     print(f"[run] {name}: {' '.join(cmd[1:])}", flush=True)
     proc = None
     for attempt in range(3):  # the axon TPU tunnel can hang at backend init
+        proc = None  # a stale failed proc must not outlive its attempt
         try:
             proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
                                   cwd=str(REPO), timeout=run_timeout)
-            break
         except subprocess.TimeoutExpired:
             print(f"[run] {name}: attempt {attempt + 1} timed out, retrying",
                   flush=True)
+            continue
+        if proc.returncode != 0 and not cpu_mesh and attempt < 2:
+            # ambient-platform runs ride the flaky tunnel, whose failure
+            # modes include fast backend-init errors, not just hangs; a
+            # CPU-mesh run is deterministic, so its nonzero rc is a real
+            # bug and must fail immediately
+            print(f"[run] {name}: attempt {attempt + 1} rc="
+                  f"{proc.returncode}, retrying\n{proc.stderr[-500:]}",
+                  flush=True)
+            proc = None
+            continue
+        break
     if proc is None:
-        raise RuntimeError(f"{name}: all attempts timed out")
+        raise RuntimeError(f"{name}: all attempts timed out or failed")
     tail = "\n".join(proc.stdout.strip().splitlines()[-3:])
     print(tail, flush=True)
     if proc.returncode != 0:
