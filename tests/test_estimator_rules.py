@@ -479,8 +479,13 @@ def test_estimator_zero1_streaming_mode(rng):
 
 def test_estimator_sparse_embed_parity(rng):
     """Estimator(sparse_embed=True) trains to the same parameters as the
-    dense path — on the no-mesh jit path AND the DP shard_map path."""
-    cfg = BertConfig.tiny_for_tests()
+    dense path — on the no-mesh jit path AND the DP shard_map path.
+
+    Dropout-free: the DP leg is shard_map (per-replica [K, B/N] shapes),
+    so its dropout draws can never match the single-device [K, B] draws —
+    the same reason the dryrun legs pin dropout to 0 for parity
+    (__graft_entry__._dryrun_dp_streaming)."""
+    cfg = BertConfig.tiny_for_tests(hidden_dropout=0.0, attention_dropout=0.0)
     train = _data(rng, cfg)
 
     def run(sparse, mesh=None):
@@ -502,7 +507,7 @@ def test_estimator_sparse_embed_parity(rng):
 
     base = run(False)
     _assert_params_close(run(True), base)
-    mesh = make_mesh(data=2)
+    mesh = make_mesh(data=2, devices=jax.devices()[:2])
     _assert_params_close(run(True, mesh=mesh), base)
 
 

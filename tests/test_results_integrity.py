@@ -18,7 +18,11 @@ REPO = Path(__file__).resolve().parent.parent
 RESULTS = REPO / "results"
 
 from examples.plot_loss import read_curve_file  # noqa: E402
-from examples.reproduce_results import BERT_RUNS, curve_stats  # noqa: E402
+from examples.reproduce_results import (  # noqa: E402
+    BERT_RUNS,
+    MNIST_RUNS,
+    curve_stats,
+)
 
 
 def _summary():
@@ -140,8 +144,6 @@ def test_committed_pngs_have_backing_data():
     evidence. The figure->curves map mirrors the overlay() calls in
     examples/reproduce_results.py; an unrecognized PNG fails outright so
     new figures must be registered here with their backing runs."""
-    from examples.reproduce_results import BERT_RUNS, MNIST_RUNS
-
     figure_backing = {
         "mnist_matrix.png": [n for n, _ in MNIST_RUNS],
         "bert_accumulation.png": [n for n, _ in BERT_RUNS],
@@ -149,6 +151,13 @@ def test_committed_pngs_have_backing_data():
     pngs = sorted(RESULTS.glob("*.png"))
     if not pngs:
         pytest.skip("no committed figures")
+    # a committed figure with NO summary at all must fail, not skip — a
+    # skip here would ship the orphaned figure green, the exact scenario
+    # this test exists to catch
+    assert (RESULTS / "summary.json").exists(), (
+        f"figures committed without results/summary.json: "
+        f"{[p.name for p in pngs]}"
+    )
     summary = _summary()
     for png in pngs:
         backing = figure_backing.get(png.name)
