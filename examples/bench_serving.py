@@ -1,0 +1,208 @@
+"""Continuous-batching serving benchmark → BENCH_serving.json.
+
+Three legs on the same tiny GPT config:
+
+1. **serial** — the baseline the engine must beat: one request at a time
+   through ``generate_cached`` (the whole generation is one XLA program,
+   so this is a STRONG baseline — zero host round-trips per token, but one
+   request per weight pass: every dense layer is a memory-bound GEMV).
+2. **engine closed-load** — all requests offered at once to the 8-slot
+   engine; the acceptance gate is aggregate tokens/s ≥ 3× serial. The win
+   is weight reuse: eight decode streams share each weight read (GEMV →
+   GEMM), the classic continuous-batching economics.
+3. **offered-load sweep** — open-loop arrivals at fractions of measured
+   capacity; reports tokens/s, TTFT p50/p99 (wall seconds), slot
+   occupancy, and queue depth per operating point.
+
+Both compiled programs (decode tick, admission prefill) are warmed up
+before any timed window — compile time is a one-off, not a serving cost.
+
+Usage: python examples/bench_serving.py [--out BENCH_serving.json] [--fast]
+(``--fast`` shrinks everything for the `slow`-marked CI test.)
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _build(fast):
+    import jax
+    import numpy as np
+
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    if fast:
+        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                        num_heads=2, intermediate_size=128,
+                        max_position_embeddings=128, dropout=0.0)
+        knobs = dict(n_requests=8, prompt_len=8, new_tokens=16, max_len=48,
+                     num_slots=4, decode_block=4)
+    else:
+        # big enough that decode is weight-bound (where batching pays),
+        # small enough to run on CPU in minutes
+        cfg = GPTConfig(vocab_size=8192, hidden_size=256, num_layers=4,
+                        num_heads=4, intermediate_size=1024,
+                        max_position_embeddings=128, dropout=0.0)
+        knobs = dict(n_requests=16, prompt_len=16, new_tokens=64, max_len=96,
+                     num_slots=8, decode_block=16)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(
+        jax.random.PRNGKey(0),
+        {"input_ids": np.zeros((1, knobs["prompt_len"]), np.int32)},
+    )
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, knobs["prompt_len"]).astype(np.int32)
+        for _ in range(knobs["n_requests"])
+    ]
+    return cfg, params, prompts, knobs
+
+
+def bench_serial(cfg, params, prompts, knobs):
+    import numpy as np
+
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+
+    new, max_len = knobs["new_tokens"], knobs["max_len"]
+    np.asarray(generate_cached(params, cfg, prompts[0], new, max_len=max_len))
+    t0 = time.perf_counter()
+    for p in prompts:
+        np.asarray(generate_cached(params, cfg, p, new, max_len=max_len))
+    dt = time.perf_counter() - t0
+    return len(prompts) * new / dt
+
+
+def _fresh_engine(cfg, params, knobs, prompts):
+    """Engine with both programs warmed at the bench's admission shape."""
+    from gradaccum_tpu.serving import Engine, Scheduler, ServingMetrics
+
+    eng = Engine(
+        params, cfg, num_slots=knobs["num_slots"], max_len=knobs["max_len"],
+        decode_block=knobs["decode_block"],
+        scheduler=Scheduler(max_queue=4 * knobs["n_requests"]),
+    )
+    for i, p in enumerate(prompts[:knobs["num_slots"]]):
+        eng.submit(p, knobs["new_tokens"], rng_seed=i)
+    eng.run_until_idle()
+    eng.metrics = ServingMetrics()  # drop warmup samples from the timed leg
+    return eng
+
+
+def bench_engine_closed(cfg, params, prompts, knobs):
+    eng = _fresh_engine(cfg, params, knobs, prompts)
+    t0 = time.perf_counter()
+    for i, p in enumerate(prompts):
+        eng.submit(p, knobs["new_tokens"], rng_seed=i)
+    eng.run_until_idle()
+    dt = time.perf_counter() - t0
+    return {
+        "tokens_per_s": len(prompts) * knobs["new_tokens"] / dt,
+        "decode_programs": eng.decode_compile_count(),
+        "prefill_programs": eng.prefill_compile_count(),
+        "occupancy_mean": eng.metrics.summary()["occupancy"]["mean"],
+    }
+
+
+def bench_open_loop(cfg, params, prompts, knobs, rate_rps):
+    """Open-loop arrivals at ``rate_rps`` requests/s; wall-clock metrics."""
+    from gradaccum_tpu.serving import QueueFull
+
+    eng = _fresh_engine(cfg, params, knobs, prompts)
+    new = knobs["new_tokens"]
+    arrivals = [i / rate_rps for i in range(len(prompts))]
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(prompts) or not eng.idle:
+        now = time.perf_counter() - t0
+        while i < len(prompts) and arrivals[i] <= now:
+            try:
+                eng.submit(prompts[i], new, rng_seed=i)
+                i += 1
+            except QueueFull:
+                break  # backpressure: retry after the next tick
+        if eng.idle:
+            time.sleep(min(1e-3, max(0.0, arrivals[i] - now)))
+            continue
+        eng.step()
+    dt = time.perf_counter() - t0
+    m = eng.metrics.summary()
+    return {
+        "offered_rps": rate_rps,
+        "tokens_per_s": len(prompts) * new / dt,
+        "ttft_s": m["ttft"],
+        "token_latency_s": m["token_latency"],
+        "occupancy_mean": m["occupancy"]["mean"],
+        "queue_depth_p99": m["queue_depth"]["p99"],
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_serving.json")
+    ap.add_argument("--fast", action="store_true",
+                    help="small shapes for the CI slow-lane test")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    cfg, params, prompts, knobs = _build(args.fast)
+
+    serial_tps = bench_serial(cfg, params, prompts, knobs)
+    print(f"serial: {serial_tps:.1f} tok/s", flush=True)
+
+    engine_leg = bench_engine_closed(cfg, params, prompts, knobs)
+    speedup = engine_leg["tokens_per_s"] / serial_tps
+    print(f"engine ({knobs['num_slots']} slots, block "
+          f"{knobs['decode_block']}): {engine_leg['tokens_per_s']:.1f} tok/s "
+          f"= {speedup:.2f}x serial, "
+          f"{engine_leg['decode_programs']} decode program(s)", flush=True)
+
+    capacity_rps = engine_leg["tokens_per_s"] / knobs["new_tokens"]
+    sweep = []
+    for frac in (0.25, 0.5, 1.5):
+        leg = bench_open_loop(cfg, params, prompts, knobs,
+                              rate_rps=max(frac * capacity_rps, 0.1))
+        leg["load_fraction"] = frac
+        sweep.append(leg)
+        print(f"load {frac:4.2f}x capacity ({leg['offered_rps']:.2f} rps): "
+              f"{leg['tokens_per_s']:.1f} tok/s, "
+              f"ttft p50 {leg['ttft_s']['p50']:.3f}s "
+              f"p99 {leg['ttft_s']['p99']:.3f}s, "
+              f"occupancy {leg['occupancy_mean']:.2f}", flush=True)
+
+    result = {
+        "bench": "continuous-batching serving engine",
+        "platform": {
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "cpu_count": os.cpu_count(),
+        },
+        "model": {
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "intermediate_size": cfg.intermediate_size,
+        },
+        "workload": knobs,
+        "serial_tokens_per_s": serial_tps,
+        "engine": engine_leg,
+        "speedup_vs_serial": speedup,
+        "sweep": sweep,
+        "acceptance": {"required_speedup": 3.0, "passed": speedup >= 3.0},
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    return result
+
+
+if __name__ == "__main__":
+    main()

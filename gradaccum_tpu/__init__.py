@@ -14,7 +14,7 @@ experiments.
 See SURVEY.md at the repo root for the file:line map to the reference.
 """
 
-from gradaccum_tpu import data, estimator, models, ops, parallel, utils
+from gradaccum_tpu import data, estimator, models, ops, parallel, serving, utils
 from gradaccum_tpu.ops.accumulation import (
     GradAccumConfig,
     accumulate_scan,

@@ -30,6 +30,7 @@ def export_predict(
     sample_batch: Dict[str, Any],
     export_dir: str,
     batch_polymorphic: bool = True,
+    extra: Dict[str, Any] = None,
 ) -> str:
     """Serialize ``lambda batch: predict_fn(params, batch)`` to
     ``export_dir`` (weights baked in). Returns the blob path.
@@ -37,6 +38,11 @@ def export_predict(
     ``sample_batch``: a dict batch fixing every leaf's shape/dtype; with
     ``batch_polymorphic`` the leading dim is exported as a symbolic ``b``
     so the artifact serves any batch size.
+
+    ``extra``: JSON-serializable metadata stored under the manifest's
+    ``"extra"`` key — the serving tier records its engine knobs here
+    (``serving.Engine.manifest()``: num_slots, max_len, decode_block, …)
+    so a redeploy compiles the same programs the artifact was validated at.
     """
     from jax import export as jexport
 
@@ -83,6 +89,8 @@ def export_predict(
         },
         "batch_polymorphic": batch_polymorphic,
     }
+    if extra is not None:
+        manifest["extra"] = extra
     with open(os.path.join(export_dir, _MANIFEST), "w") as f:
         json.dump(manifest, f, indent=1, sort_keys=True)
     return blob_path

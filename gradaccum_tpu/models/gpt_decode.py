@@ -61,12 +61,14 @@ def _merge_heads(t):
 
 
 def _attend(q, k, v, pos_mask):
-    """q: [B,H,Sq,hd]; k/v: [B,H,T,hd]; pos_mask: [Sq or 1, T] additive."""
+    """q: [B,H,Sq,hd]; k/v: [B,H,T,hd]; pos_mask: additive, broadcastable to
+    [B,H,Sq,T] (callers supply the leading axes — per-example masks carry a
+    real batch dim for the ragged/serving paths)."""
     depth = q.shape[-1]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
         jnp.asarray(depth, q.dtype)
     )
-    scores = scores + pos_mask[None, None]
+    scores = scores + pos_mask
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
@@ -120,12 +122,22 @@ def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> DecodeCache:
     )
 
 
-def prefill(params, cfg: GPTConfig, prompt_ids, max_len: int):
+def prefill(params, cfg: GPTConfig, prompt_ids, max_len: int, lengths=None):
     """Run the prompt through the model once, filling the cache.
 
     Returns ``(cache, last_logits [B, vocab])``. ``prompt_ids``: [B, S0]
-    int32, S0 <= max_len (S0 is static — pad prompts host-side to a common
-    length and mask via the causal structure if needed).
+    int32, S0 <= max_len (S0 is static).
+
+    ``lengths`` (optional, [B] int32, 1 <= lengths <= S0) enables RAGGED
+    batches: each row is LEFT-padded so its real tokens occupy the last
+    ``lengths[b]`` columns (the final column is always real, so
+    ``last_logits`` stays the next-token logits for every row). Positions
+    and the attention mask ignore the pad, and each row's K/V are compacted
+    to cache positions ``[0, lengths[b])`` — exactly the layout the
+    single-prompt path produces — so ``cache.length`` becomes a [B] vector
+    and decoding continues per-row via :func:`decode_step_ragged`.
+    Without ``lengths`` the behavior is the original dense path
+    (``cache.length`` is a scalar, all rows length S0).
     """
     b, s0 = prompt_ids.shape
     if s0 > max_len:
@@ -133,10 +145,28 @@ def prefill(params, cfg: GPTConfig, prompt_ids, max_len: int):
             f"prompt length {s0} exceeds max_len {max_len}: the KV cache "
             "is allocated at max_len, so the prompt cannot fit"
         )
-    cache = init_cache(cfg, b, max_len)
-    x = _embed(params, cfg, prompt_ids, jnp.arange(s0)[None, :])
+    ragged = lengths is not None
     causal = jnp.tril(jnp.ones((s0, s0), jnp.float32))
-    pos_mask = ((1.0 - causal) * -1e9).astype(cfg.dtype)
+    if ragged:
+        lengths = jnp.asarray(lengths, jnp.int32)
+        if lengths.shape != (b,):
+            raise ValueError(f"lengths must be [batch]={b}, got {lengths.shape}")
+        if not isinstance(lengths, jax.core.Tracer) and (
+            bool((lengths < 1).any()) or bool((lengths > s0).any())
+        ):
+            raise ValueError(
+                f"lengths must be in [1, S0={s0}] per row, got {lengths}"
+            )
+        pad = s0 - lengths  # [B] left-pad per row
+        positions = jnp.maximum(jnp.arange(s0)[None, :] - pad[:, None], 0)
+        # key j visible to query i iff causal AND j is a real token
+        real = (jnp.arange(s0)[None, :] >= pad[:, None]).astype(jnp.float32)
+        visible = causal[None] * real[:, None, :]  # [B, S0, S0]
+        pos_mask = ((1.0 - visible) * -1e9).astype(cfg.dtype)[:, None]
+    else:
+        positions = jnp.arange(s0)[None, :]
+        pos_mask = ((1.0 - causal) * -1e9).astype(cfg.dtype)[None, None]
+    x = _embed(params, cfg, prompt_ids, positions)
 
     ks, vs = [], []
 
@@ -149,11 +179,23 @@ def prefill(params, cfg: GPTConfig, prompt_ids, max_len: int):
         ks.append(k)
         vs.append(v)
 
-    pad = max_len - s0
-    k_stack = jnp.pad(jnp.stack(ks), ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
-    v_stack = jnp.pad(jnp.stack(vs), ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
-    cache = DecodeCache(k=k_stack, v=v_stack,
-                        length=jnp.asarray(s0, jnp.int32))
+    k_stack, v_stack = jnp.stack(ks), jnp.stack(vs)  # [L, B, H, S0, hd]
+    if ragged:
+        # compact: cache position t takes prompt column t + pad (left shift),
+        # zeroed past each row's length so free tail positions stay inert
+        idx = jnp.clip(jnp.arange(max_len)[None, :] + pad[:, None], 0, s0 - 1)
+        keep = jnp.arange(max_len)[None, :] < lengths[:, None]  # [B, T]
+        idx5 = idx[None, :, None, :, None]
+        keep5 = keep[None, :, None, :, None]
+        k_stack = jnp.where(keep5, jnp.take_along_axis(k_stack, idx5, axis=3), 0)
+        v_stack = jnp.where(keep5, jnp.take_along_axis(v_stack, idx5, axis=3), 0)
+        length = lengths
+    else:
+        tail = ((0, 0), (0, 0), (0, 0), (0, max_len - s0), (0, 0))
+        k_stack = jnp.pad(k_stack, tail)
+        v_stack = jnp.pad(v_stack, tail)
+        length = jnp.asarray(s0, jnp.int32)
+    cache = DecodeCache(k=k_stack, v=v_stack, length=length)
     logits = _lm_head(params, cfg, x[:, -1:, :])[:, 0]
     return cache, logits
 
@@ -168,7 +210,7 @@ def decode_step(params, cfg: GPTConfig, cache: DecodeCache, token):
     max_len = cache.k.shape[3]
     # keys at positions <= pos are visible (the new token writes at pos)
     visible = jnp.arange(max_len) <= pos
-    pos_mask = jnp.where(visible, 0.0, -1e9).astype(cfg.dtype)[None, :]
+    pos_mask = jnp.where(visible, 0.0, -1e9).astype(cfg.dtype)[None, None, None, :]
 
     p = params["params"]
     new_k, new_v = cache.k, cache.v
@@ -192,19 +234,97 @@ def decode_step(params, cfg: GPTConfig, cache: DecodeCache, token):
     return DecodeCache(k=new_k, v=new_v, length=pos + 1), logits
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4, 5))
-def _generate_jit(cfg, params, ids, num_steps, temperature, max_len, rng):
+def decode_step_ragged(params, cfg: GPTConfig, cache: DecodeCache, token,
+                       active=None):
+    """Batched cached step with PER-ROW positions: ``cache.length`` is [B]
+    int32 (the ragged-prefill layout), ``token`` [B] is each row's newest
+    token, written at its own ``length[b]``. Rows where ``active`` is False
+    are computed but neither written nor advanced — the serving engine's
+    fixed-slot tick runs every slot through one compiled program and masks
+    the empty ones. Returns ``(new_cache, logits [B, vocab])``. Jittable;
+    all shapes static.
+
+    The K/V write is a batched SCATTER at per-row traced positions (not
+    ``dynamic_update_slice``, whose start index is shared across the
+    batch). Masked rows — inactive slots, or a full slot whose position
+    has reached ``max_len`` — are redirected to an out-of-bounds index,
+    which XLA scatter semantics DROP rather than clamp, so they write
+    nothing. Updating the [L, B, H, T, hd] carry in place (instead of
+    rebuilding it with one-hot selects) is what lets the serving tick's
+    ``lax.scan`` alias the cache across micro-steps rather than copy the
+    whole pool every token.
+    """
+    b = token.shape[0]
+    pos = cache.length  # [B]
+    if active is None:
+        active = jnp.ones((b,), bool)
+    x = _embed(params, cfg, token[:, None], pos[:, None])
+    max_len = cache.k.shape[3]
+    num_heads = cache.k.shape[2]
+    visible = jnp.arange(max_len)[None, :] <= pos[:, None]  # [B, T]
+    pos_mask = jnp.where(visible, 0.0, -1e9).astype(cfg.dtype)[:, None, None, :]
+    # out-of-bounds scatter index == dropped write (masked rows)
+    wpos = jnp.where(active, pos, max_len)[:, None]  # [B, 1]
+    bidx = jnp.arange(b)[:, None]        # [B, 1]
+    hidx = jnp.arange(num_heads)[None]   # [1, H]
+
+    p = params["params"]
+    new_k, new_v = cache.k, cache.v
+
+    for i in range(cfg.num_layers):
+
+        def attend_cached(q, k, v, i=i):
+            nonlocal new_k, new_v
+            new_k = new_k.at[i, bidx, hidx, wpos].set(
+                k[:, :, 0, :].astype(new_k.dtype)
+            )
+            new_v = new_v.at[i, bidx, hidx, wpos].set(
+                v[:, :, 0, :].astype(new_v.dtype)
+            )
+            return _attend(q, new_k[i], new_v[i], pos_mask), None
+
+        x, _ = _block(cfg, p[f"layer_{i}"], x, attend_cached)
+
+    logits = _lm_head(params, cfg, x)[:, 0]
+    new_len = jnp.where(active, pos + 1, pos)
+    return DecodeCache(k=new_k, v=new_v, length=new_len), logits
+
+
+def _top_k_mask(logits, k: int):
+    """Keep the k largest logits (ties at the threshold all survive), mask
+    the rest to -inf. ``k`` is static so the program shape never changes."""
+    vals = jax.lax.top_k(logits, k)[0]
+    return jnp.where(logits >= vals[..., -1:], logits, -jnp.inf)
+
+
+def sample_token(logits, rng, index, temperature: float, top_k=None):
+    """The one next-token rule shared by :func:`generate_cached` and the
+    serving engine (parity between the two depends on this being the same
+    computation). ``logits`` [..., V]; ``index`` is the 0-based position of
+    the token being picked — the rng is folded with it, the
+    ``fold_in(rng, i)`` scheme of gpt.py::greedy_generate. ``temperature``
+    and ``top_k`` are static. temperature 0 → argmax (top-k masking cannot
+    change the argmax, so greedy ignores it); top_k=1 ≡ greedy by
+    construction."""
+    if top_k is not None:
+        logits = _top_k_mask(logits, top_k)
+    if temperature > 0:
+        return jax.random.categorical(
+            jax.random.fold_in(rng, index), logits / temperature, axis=-1
+        )
+    return jnp.argmax(logits, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 5, 6))
+def _generate_jit(cfg, params, ids, num_steps, temperature, max_len, top_k,
+                  rng):
     """One compiled program for the whole generation: prefill + ``lax.scan``
     over cached decode steps. Module-level so repeat calls with the same
     static config hit jax's jit cache instead of recompiling."""
     cache, logits = prefill(params, cfg, ids, max_len)
 
     def pick(logits, i):
-        if temperature > 0:
-            return jax.random.categorical(
-                jax.random.fold_in(rng, i), logits / temperature, axis=-1
-            )
-        return jnp.argmax(logits, axis=-1)
+        return sample_token(logits, rng, i, temperature, top_k)
 
     def body(carry, i):
         cache, logits = carry
@@ -217,10 +337,14 @@ def _generate_jit(cfg, params, ids, num_steps, temperature, max_len, rng):
 
 
 def generate_cached(params, cfg: GPTConfig, prompt_ids, num_steps: int,
-                    temperature: float = 0.0, rng=None, max_len=None):
-    """Greedy when ``temperature == 0`` else temperature sampling. Drop-in
-    for :func:`gradaccum_tpu.models.gpt.greedy_generate` (same outputs, same
-    seeding scheme), O(S) per token instead of O(S²).
+                    temperature: float = 0.0, rng=None, max_len=None,
+                    top_k=None):
+    """Greedy when ``temperature == 0`` else temperature sampling, optionally
+    truncated to the ``top_k`` most likely tokens. Drop-in for
+    :func:`gradaccum_tpu.models.gpt.greedy_generate` (same outputs, same
+    seeding scheme), O(S) per token instead of O(S²). ``top_k`` is a static
+    int so the whole generation stays ONE compiled XLA program; ``top_k=1``
+    is exactly greedy.
 
     Returns [B, S0 + num_steps] token ids.
     """
@@ -234,8 +358,14 @@ def generate_cached(params, cfg: GPTConfig, prompt_ids, num_steps: int,
         max_len = s0 + num_steps
     if s0 + num_steps > max_len:
         raise ValueError(f"prompt {s0} + steps {num_steps} exceed max_len {max_len}")
+    if top_k is not None:
+        top_k = int(top_k)
+        if not 1 <= top_k <= cfg.vocab_size:
+            raise ValueError(
+                f"top_k must be in [1, vocab_size={cfg.vocab_size}], got {top_k}"
+            )
     if rng is None:
         rng = jax.random.PRNGKey(0)  # unused when greedy; keeps the jit signature
     new_tokens = _generate_jit(cfg, params, ids, num_steps, temperature,
-                               max_len, rng)
+                               max_len, top_k, rng)
     return jnp.concatenate([ids, new_tokens], axis=1)

@@ -1,0 +1,36 @@
+"""Continuous-batching inference engine on the KV-cache decode path.
+
+The training side of this repo compiles the whole grad-accumulation loop
+into one static-shape XLA program; serving applies the same discipline to
+inference. A fixed pool of decode SLOTS (``cache_pool``) is stepped by one
+compiled decode tick (``engine``) that advances every active request at its
+own cache position — admissions batch-prefill into free slots
+(left-padded, masked, via the ragged ``models/gpt_decode.py::prefill``),
+retirements free them, and the tick program never recompiles. Admission
+control with backpressure and deadlines lives in ``scheduler``; a threaded
+front-end plus a deterministic seeded simulation driver in ``server``;
+TTFT / throughput / occupancy telemetry in ``metrics``.
+"""
+
+from gradaccum_tpu.serving.cache_pool import CachePool
+from gradaccum_tpu.serving.engine import Engine, StepEvents
+from gradaccum_tpu.serving.metrics import ServingMetrics
+from gradaccum_tpu.serving.scheduler import QueueFull, Request, Scheduler
+from gradaccum_tpu.serving.server import (
+    ServingServer,
+    SimulationDriver,
+    StreamHandle,
+)
+
+__all__ = [
+    "CachePool",
+    "Engine",
+    "StepEvents",
+    "ServingMetrics",
+    "QueueFull",
+    "Request",
+    "Scheduler",
+    "ServingServer",
+    "SimulationDriver",
+    "StreamHandle",
+]

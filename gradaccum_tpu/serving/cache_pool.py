@@ -1,0 +1,84 @@
+"""Fixed-slot KV-cache pool: the engine's only device memory.
+
+A ``CachePool`` owns one ``[num_layers, num_slots, heads, max_len, head_dim]``
+K/V pair (the :class:`~gradaccum_tpu.models.gpt_decode.DecodeCache` layout
+with the batch axis reinterpreted as SLOTS) plus a ``[num_slots]`` length
+vector. It is allocated once and never reallocated or reshaped — requests
+come and go by claiming/releasing slot indices host-side while every device
+program keeps the same static shapes, so the decode tick compiles exactly
+once. A released slot needs no device work at all: its stale K/V tail is
+masked by the per-slot length, and the next admission's prefill scatter
+overwrites positions ``[0, len)``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from gradaccum_tpu.models.gpt import GPTConfig
+from gradaccum_tpu.models.gpt_decode import DecodeCache, init_cache
+
+
+class CachePool:
+    """Slot bookkeeping (host) + the pooled cache arrays (device)."""
+
+    def __init__(self, cfg: GPTConfig, num_slots: int, max_len: int):
+        if num_slots < 1:
+            raise ValueError(f"need at least one slot, got {num_slots}")
+        cache = init_cache(cfg, num_slots, max_len)  # validates max_len
+        self.k = cache.k
+        self.v = cache.v
+        self.lengths = jnp.zeros((num_slots,), jnp.int32)
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self._free: List[int] = list(range(num_slots - 1, -1, -1))
+        self._claimed = [False] * num_slots
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return self.num_slots - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.active_count / self.num_slots
+
+    def claim(self) -> Optional[int]:
+        """Lowest free slot index, or None when the pool is full."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._claimed[slot] = True
+        return slot
+
+    def claim_many(self, n: int) -> List[int]:
+        slots = []
+        for _ in range(n):
+            slot = self.claim()
+            if slot is None:
+                break
+            slots.append(slot)
+        return slots
+
+    def release(self, slot: int) -> None:
+        if not self._claimed[slot]:
+            raise ValueError(f"slot {slot} is not claimed")
+        self._claimed[slot] = False
+        self._free.append(slot)
+        self._free.sort(reverse=True)  # deterministic: lowest slot next
+
+    def as_cache(self) -> DecodeCache:
+        """The pool as a DecodeCache (per-slot vector length) for the tick."""
+        return DecodeCache(k=self.k, v=self.v, length=self.lengths)
+
+    def set_arrays(self, k, v, lengths) -> None:
+        """Store a device program's updated pool (shapes must be unchanged —
+        anything else means a slot leaked out of the static discipline)."""
+        if k.shape != self.k.shape or v.shape != self.v.shape:
+            raise ValueError("pool shape changed — static shapes are the contract")
+        self.k, self.v, self.lengths = k, v, lengths

@@ -1,0 +1,371 @@
+"""The continuous-batching engine: one compiled decode tick, many requests.
+
+Slot-based continuous batching in the static-shape discipline the training
+side's accumulation scan established: a ``CachePool`` of ``num_slots``
+decode slots is advanced by ONE jitted tick program per token. Every tick
+steps ALL slots (``decode_step_ragged`` — each at its own cache position,
+inactive ones masked), samples every slot's next token with its own
+per-request rng stream, and returns the updated pool. Shapes never depend
+on load, so after the first tick the program NEVER recompiles — admissions
+and retirements only flip host-side slot bookkeeping.
+
+Admission batches queued prompts into a single ragged left-padded
+``prefill`` (lengths-masked, compacted into the claimed slots by one
+scatter). Prefill programs are compiled per (batch, bucketed-length) pair —
+a small bounded set since prompt lengths are bucketed to powers of two —
+while the decode tick, where serving spends its life, stays a single
+program (asserted in tests via the jit cache size).
+
+Greedy outputs are token-for-token identical to running
+:func:`~gradaccum_tpu.models.gpt_decode.generate_cached` on each request
+alone (the engine-parity gate in tests/test_serving.py): same prefill math
+(pad positions masked out of softmax exactly), same cache layout, same
+``sample_token`` rule. Continuous batching changes throughput, never
+results.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gradaccum_tpu.models.gpt import GPTConfig
+from gradaccum_tpu.models.gpt_decode import (
+    DecodeCache,
+    decode_step_ragged,
+    prefill,
+    sample_token,
+)
+from gradaccum_tpu.serving.cache_pool import CachePool
+from gradaccum_tpu.serving.metrics import ServingMetrics
+from gradaccum_tpu.serving.scheduler import Request, Scheduler
+from gradaccum_tpu.utils.profiling import StepWindowProfiler
+
+
+@dataclasses.dataclass
+class StepEvents:
+    """What one engine tick did, for front-ends to stream out."""
+
+    emitted: List[Tuple[int, int]]    # (request_id, token)
+    finished: List[Tuple[int, str]]   # (request_id, reason: eos|length|timeout)
+    admitted: List[int]               # request_ids prefilled this tick
+    tick: int
+
+
+def _make_tick_fn(cfg: GPTConfig, temperature: float, top_k, block: int):
+    """One compiled tick = ``lax.scan`` over ``block`` decode micro-steps —
+    the accumulation-scan trick applied to serving. A block emits ``block``
+    tokens per active slot for ONE host dispatch + ONE token readback, so
+    the Python/tick overhead amortizes away; admission and retirement
+    happen at block granularity. The pool buffers are DONATED: XLA updates
+    the cache in place instead of copying ``[L, slots, H, T, hd]`` twice
+    per tick."""
+
+    def tick(params, k, v, lengths, cur_tok, gen_count, rngs, active):
+        def pick(lg, key, idx):
+            return sample_token(lg, key, idx, temperature, top_k)
+
+        def body(carry, _):
+            cache, cur, gen = carry
+            new_cache, logits = decode_step_ragged(params, cfg, cache, cur,
+                                                   active)
+            nxt = jax.vmap(pick)(logits, rngs, gen).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, cur)
+            gen = gen + active.astype(jnp.int32)
+            return (new_cache, nxt, gen), nxt
+
+        carry0 = (DecodeCache(k=k, v=v, length=lengths), cur_tok, gen_count)
+        (cache, cur, gen), toks = jax.lax.scan(body, carry0, None,
+                                               length=block)
+        return cache.k, cache.v, cache.length, cur, gen, toks  # toks [block, S]
+
+    return jax.jit(tick, donate_argnums=(1, 2, 3, 4, 5))
+
+
+def _make_admit_fn(cfg: GPTConfig, temperature: float, top_k, max_len: int):
+    def admit(params, k, v, lengths, cur_tok, gen_count, rngs,
+              ids, prompt_lens, slots, keys):
+        cache, logits = prefill(params, cfg, ids, max_len, lengths=prompt_lens)
+
+        def pick(lg, key):
+            return sample_token(lg, key, 0, temperature, top_k)
+
+        tok0 = jax.vmap(pick)(logits, keys).astype(jnp.int32)
+        k = k.at[:, slots].set(cache.k.astype(k.dtype))
+        v = v.at[:, slots].set(cache.v.astype(v.dtype))
+        lengths = lengths.at[slots].set(cache.length)
+        cur_tok = cur_tok.at[slots].set(tok0)
+        gen_count = gen_count.at[slots].set(1)
+        rngs = rngs.at[slots].set(keys)
+        return k, v, lengths, cur_tok, gen_count, rngs, tok0
+
+    return jax.jit(admit, donate_argnums=(1, 2, 3, 4, 5, 6))
+
+
+class Engine:
+    """Multiplexes concurrent generation requests through one decode tick.
+
+    Sampling knobs (``temperature``, ``top_k``) are ENGINE-level statics —
+    baked into the two compiled programs — while the rng stream is
+    per-request (``Request.rng_seed``). ``decode_block`` is the
+    throughput/latency knob: each tick scans that many decode micro-steps
+    device-side before the host sees tokens, so dispatch overhead is paid
+    once per block (tokens stream in chunks of ``decode_block``; a request
+    finishing mid-block wastes the block's remaining micro-steps on that
+    slot). Not thread-safe: the threaded front-end in server.py serializes
+    access.
+    """
+
+    def __init__(
+        self,
+        params,
+        cfg: GPTConfig,
+        num_slots: int = 4,
+        max_len: int = 128,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        decode_block: int = 1,
+        scheduler: Optional[Scheduler] = None,
+        metrics: Optional[ServingMetrics] = None,
+        min_prefill_bucket: int = 8,
+        profile_dir: Optional[str] = None,
+        profile_start_tick: int = 0,
+        profile_num_ticks: int = 0,
+    ):
+        if top_k is not None and temperature <= 0:
+            raise ValueError("top_k sampling needs temperature > 0 "
+                             "(top_k with temperature 0 is just greedy)")
+        if top_k is not None and not 1 <= int(top_k) <= cfg.vocab_size:
+            raise ValueError(f"top_k must be in [1, {cfg.vocab_size}]")
+        if decode_block < 1:
+            raise ValueError(f"decode_block must be >= 1, got {decode_block}")
+        self.params = params
+        self.cfg = cfg
+        self.max_len = max_len
+        self.temperature = float(temperature)
+        self.top_k = None if top_k is None else int(top_k)
+        self.pool = CachePool(cfg, num_slots, max_len)
+        self.scheduler = scheduler or Scheduler()
+        self.metrics = metrics or ServingMetrics()
+        self.min_prefill_bucket = min_prefill_bucket
+        self._profiler = StepWindowProfiler(
+            profile_dir, profile_start_tick, profile_num_ticks
+        )
+
+        key0 = jax.random.PRNGKey(0)
+        self._cur_tok = jnp.zeros((num_slots,), jnp.int32)
+        self._gen = jnp.zeros((num_slots,), jnp.int32)
+        self._rngs = jnp.zeros((num_slots,) + key0.shape, key0.dtype)
+        self._active = np.zeros((num_slots,), bool)
+        self._slot_req: List[Optional[Request]] = [None] * num_slots
+
+        self.decode_block = int(decode_block)
+        self._tick_fn = _make_tick_fn(cfg, self.temperature, self.top_k,
+                                      self.decode_block)
+        self._admit_fn = _make_admit_fn(cfg, self.temperature, self.top_k,
+                                        max_len)
+        self._tick = 0
+        self._next_id = 0
+        # per-request outputs; long-running front-ends MUST evict via
+        # pop_result() once consumed or host memory grows with traffic
+        self.results: Dict[int, List[int]] = {}
+        self.status: Dict[int, str] = {}
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def idle(self) -> bool:
+        return self.scheduler.depth == 0 and self.pool.active_count == 0
+
+    @property
+    def tick_count(self) -> int:
+        return self._tick
+
+    def decode_compile_count(self) -> int:
+        """Distinct decode-tick programs compiled so far. The engine-parity
+        gate asserts this is exactly 1 after any amount of traffic."""
+        return self._tick_fn._cache_size()
+
+    def prefill_compile_count(self) -> int:
+        """Distinct (batch, bucketed-length) prefill programs — bounded by
+        the bucket set, not by traffic."""
+        return self._admit_fn._cache_size()
+
+    def manifest(self) -> dict:
+        """The engine's static serving shape, for the export manifest
+        (estimator/export.py): redeploying with these knobs reproduces the
+        exact compiled programs this engine was validated/benchmarked at."""
+        return {
+            "num_slots": self.pool.num_slots,
+            "max_len": self.max_len,
+            "decode_block": self.decode_block,
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "min_prefill_bucket": self.min_prefill_bucket,
+        }
+
+    # -- request intake ---------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        eos_id: Optional[int] = None,
+        rng_seed: int = 0,
+        deadline_ticks: Optional[int] = None,
+    ) -> int:
+        """Queue one request; returns its id. Raises
+        :class:`~gradaccum_tpu.serving.scheduler.QueueFull` on backpressure
+        and ValueError for requests that could never fit the cache."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new_tokens {max_new_tokens} "
+                f"exceed max_len {self.max_len}"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        req = Request(
+            request_id=rid,
+            prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            eos_id=eos_id,
+            rng_seed=int(rng_seed),
+            deadline_tick=(None if deadline_ticks is None
+                           else self._tick + int(deadline_ticks)),
+            submit_tick=self._tick,
+        )
+        try:
+            self.scheduler.submit(req)
+        except Exception:
+            self.metrics.record_reject(rid)
+            raise
+        self.results[rid] = []
+        self.status[rid] = "queued"
+        self.metrics.record_submit(rid)
+        return rid
+
+    # -- the tick ---------------------------------------------------------
+
+    def step(self) -> StepEvents:
+        """One engine tick: expire → admit/prefill → fused decode."""
+        t = self._tick
+        self._profiler.observe(t)
+        emitted: List[Tuple[int, int]] = []
+        finished: List[Tuple[int, str]] = []
+        admitted: List[int] = []
+
+        for req in self.scheduler.expire(t):
+            self.status[req.request_id] = "timeout"
+            finished.append((req.request_id, "timeout"))
+            self.metrics.record_finish(req.request_id, "timeout")
+
+        reqs = self.scheduler.admit(self.pool.free_count, t)
+        if reqs:
+            self._admit(reqs, emitted, finished, admitted)
+
+        active_now = self._active.copy()
+        if active_now.any():
+            out = self._tick_fn(
+                self.params, self.pool.k, self.pool.v, self.pool.lengths,
+                self._cur_tok, self._gen, self._rngs, jnp.asarray(active_now),
+            )
+            k, v, lengths, nxt, gen, toks = out
+            self.pool.set_arrays(k, v, lengths)
+            self._cur_tok, self._gen = nxt, gen
+            toks_host = np.asarray(jax.device_get(toks))  # [block, slots]
+            for d in range(toks_host.shape[0]):
+                for slot in np.nonzero(active_now)[0]:
+                    req = self._slot_req[slot]
+                    if req is None:  # retired earlier in this block
+                        continue
+                    self._emit(int(slot), req, int(toks_host[d, slot]),
+                               emitted, finished, first=False)
+
+        self.metrics.record_tick(
+            self.scheduler.depth, self.pool.active_count, self.pool.num_slots
+        )
+        self._tick = t + 1
+        return StepEvents(emitted, finished, admitted, t)
+
+    def pop_result(self, request_id: int) -> Tuple[List[int], str]:
+        """Remove and return ``(tokens, status)`` for a finished (or
+        expired) request. The streaming/driver front-ends call this on
+        finish so engine-side bookkeeping stays bounded under sustained
+        traffic."""
+        return (self.results.pop(request_id),
+                self.status.pop(request_id))
+
+    def run_until_idle(self, max_ticks: int = 100_000) -> List[StepEvents]:
+        events = []
+        while not self.idle:
+            if len(events) >= max_ticks:
+                raise RuntimeError(f"engine not idle after {max_ticks} ticks")
+            events.append(self.step())
+        return events
+
+    def close(self) -> None:
+        self._profiler.close()
+        self.metrics.flush()
+
+    # -- internals --------------------------------------------------------
+
+    def _bucket_len(self, n: int) -> int:
+        b = self.min_prefill_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.max_len)
+
+    def _admit(self, reqs, emitted, finished, admitted) -> None:
+        slots = self.pool.claim_many(len(reqs))
+        assert len(slots) == len(reqs), "scheduler admitted beyond free slots"
+        s0 = self._bucket_len(max(r.prompt.size for r in reqs))
+        ids = np.zeros((len(reqs), s0), np.int32)
+        lens = np.zeros((len(reqs),), np.int32)
+        for i, r in enumerate(reqs):
+            ids[i, s0 - r.prompt.size:] = r.prompt
+            lens[i] = r.prompt.size
+        keys = jnp.stack([jax.random.PRNGKey(r.rng_seed) for r in reqs])
+        out = self._admit_fn(
+            self.params, self.pool.k, self.pool.v, self.pool.lengths,
+            self._cur_tok, self._gen, self._rngs,
+            jnp.asarray(ids), jnp.asarray(lens),
+            jnp.asarray(slots, jnp.int32), keys,
+        )
+        k, v, lengths, self._cur_tok, self._gen, self._rngs, tok0 = out
+        self.pool.set_arrays(k, v, lengths)
+        tok0_host = np.asarray(jax.device_get(tok0))
+        for slot, req, tok in zip(slots, reqs, tok0_host):
+            self._slot_req[slot] = req
+            self._active[slot] = True
+            self.status[req.request_id] = "running"
+            admitted.append(req.request_id)
+            self._emit(slot, req, int(tok), emitted, finished, first=True)
+
+    def _emit(self, slot: int, req: Request, token: int,
+              emitted, finished, first: bool) -> None:
+        rid = req.request_id
+        out = self.results[rid]
+        out.append(token)
+        emitted.append((rid, token))
+        self.metrics.record_token(rid, first=first)
+        reason = None
+        if req.eos_id is not None and token == req.eos_id:
+            reason = "eos"
+        elif len(out) >= req.max_new_tokens:
+            reason = "length"
+        if reason is not None:
+            self._active[slot] = False
+            self._slot_req[slot] = None
+            self.pool.release(slot)
+            self.status[rid] = "done"
+            finished.append((rid, reason))
+            self.metrics.record_finish(rid, reason)

@@ -1,0 +1,113 @@
+"""Serving telemetry: TTFT, per-token latency, throughput, occupancy.
+
+All host-side and allocation-free on the decode path — the engine calls in
+with plain ints/floats it already has. The clock is injectable so the
+deterministic simulation driver can run on the LOGICAL tick clock (results
+reproducible bit-for-bit) while the threaded server uses wall time.
+
+Scalars stream into TensorBoard through the same
+:class:`~gradaccum_tpu.estimator.events.EventWriter` the training loop
+uses (``model_dir/serving``), so one ``tensorboard --logdir`` shows the
+training curves next to queue depth / occupancy / tokens-per-second.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from gradaccum_tpu.estimator.events import EventWriter
+from gradaccum_tpu.utils.timing import LatencySeries
+
+
+class ServingMetrics:
+    """Aggregates per-request latencies and per-tick engine gauges."""
+
+    def __init__(
+        self,
+        event_writer: Optional[EventWriter] = None,
+        subdir: str = "serving",
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.clock = clock
+        self._writer = event_writer
+        self._subdir = subdir
+        self.ttft = LatencySeries()          # submit -> first token
+        self.token_latency = LatencySeries()  # inter-token gap, per request
+        self.queue_depth = LatencySeries()    # sampled per tick
+        self.occupancy = LatencySeries()      # sampled per tick
+        self._submit_t: Dict[int, float] = {}
+        self._last_token_t: Dict[int, float] = {}
+        self.tokens_emitted = 0
+        self.ticks = 0
+        self.finished: Dict[str, int] = {}  # reason -> count
+        self.rejected = 0
+        self._t0: Optional[float] = None
+
+    # -- per-request lifecycle -------------------------------------------
+
+    def record_submit(self, request_id: int) -> None:
+        now = self.clock()
+        if self._t0 is None:
+            self._t0 = now
+        self._submit_t[request_id] = now
+
+    def record_reject(self, request_id: int) -> None:
+        self.rejected += 1
+
+    def record_token(self, request_id: int, first: bool) -> None:
+        now = self.clock()
+        if first and request_id in self._submit_t:
+            self.ttft.add(now - self._submit_t[request_id])
+        elif request_id in self._last_token_t:
+            self.token_latency.add(now - self._last_token_t[request_id])
+        self._last_token_t[request_id] = now
+        self.tokens_emitted += 1
+
+    def record_finish(self, request_id: int, reason: str) -> None:
+        self.finished[reason] = self.finished.get(reason, 0) + 1
+        self._submit_t.pop(request_id, None)
+        self._last_token_t.pop(request_id, None)
+
+    # -- per-tick gauges --------------------------------------------------
+
+    def record_tick(self, queue_depth: int, active_slots: int,
+                    num_slots: int) -> None:
+        self.ticks += 1
+        self.queue_depth.add(queue_depth)
+        self.occupancy.add(active_slots / num_slots)
+        if self._writer is not None and self._writer.active:
+            self._writer.scalars(
+                {
+                    "serving/queue_depth": float(queue_depth),
+                    "serving/active_slots": float(active_slots),
+                    "serving/tokens_emitted": float(self.tokens_emitted),
+                },
+                step=self.ticks,
+                subdir=self._subdir,
+            )
+
+    # -- summary ----------------------------------------------------------
+
+    def tokens_per_second(self) -> Optional[float]:
+        if self._t0 is None or self.tokens_emitted == 0:
+            return None
+        dt = self.clock() - self._t0
+        return self.tokens_emitted / dt if dt > 0 else None
+
+    def summary(self) -> dict:
+        return {
+            "ttft": self.ttft.summary(),
+            "token_latency": self.token_latency.summary(),
+            "queue_depth": self.queue_depth.summary(),
+            "occupancy": self.occupancy.summary(),
+            "tokens_emitted": self.tokens_emitted,
+            "tokens_per_second": self.tokens_per_second(),
+            "ticks": self.ticks,
+            "finished": dict(self.finished),
+            "rejected": self.rejected,
+        }
+
+    def flush(self) -> None:
+        if self._writer is not None:
+            self._writer.flush()

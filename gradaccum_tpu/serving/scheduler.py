@@ -1,0 +1,111 @@
+"""Admission control: bounded FIFO queue, backpressure, deadlines, policy.
+
+The queue is host-side and intentionally boring — all the cleverness the
+TPU needs is static shapes downstream. What matters here is the contract
+with callers: ``submit`` REJECTS when the queue is full (raising
+:class:`QueueFull`) instead of buffering unboundedly, queued requests whose
+deadline passes are expired without ever touching the device, and the
+prefill/decode interleaving knobs bound how much prefill work any single
+tick can inject ahead of running decodes (a long admission burst otherwise
+stalls every active request's next token).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, List, Optional
+
+import numpy as np
+
+
+class QueueFull(RuntimeError):
+    """Backpressure signal: the admission queue is at capacity — retry
+    later or shed load upstream. Deliberately an exception, not a silent
+    drop, so front-ends must decide."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request as the scheduler/engine see it.
+
+    ``deadline_tick`` bounds QUEUE time: a request still queued past it is
+    expired with reason "timeout" (once admitted it runs to completion —
+    slots are cheap, re-queueing is not). ``rng_seed`` feeds the per-request
+    sampling stream (``fold_in(PRNGKey(seed), token_index)``), matching
+    ``generate_cached(rng=PRNGKey(seed))`` token-for-token.
+    """
+
+    request_id: int
+    prompt: np.ndarray  # [S] int32
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    rng_seed: int = 0
+    deadline_tick: Optional[int] = None
+    submit_tick: int = 0
+
+
+class Scheduler:
+    """Bounded FIFO with reject-when-full and prefill/decode interleaving.
+
+    ``max_queue``: queue capacity (beyond the slots already running).
+    ``max_prefill_per_tick``: cap on admissions per tick — bounds the
+    prefill batch (and therefore the prefill program's batch axis).
+    ``prefill_interval``: admit only every N-th tick; between admission
+    ticks the engine runs pure decode ticks, trading TTFT for smoother
+    per-token latency under load.
+    """
+
+    def __init__(
+        self,
+        max_queue: int = 64,
+        max_prefill_per_tick: Optional[int] = None,
+        prefill_interval: int = 1,
+    ):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if prefill_interval < 1:
+            raise ValueError(
+                f"prefill_interval must be >= 1, got {prefill_interval}"
+            )
+        self.max_queue = max_queue
+        self.max_prefill_per_tick = max_prefill_per_tick
+        self.prefill_interval = prefill_interval
+        self._queue: Deque[Request] = deque()
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def submit(self, request: Request) -> None:
+        if len(self._queue) >= self.max_queue:
+            raise QueueFull(
+                f"admission queue at capacity ({self.max_queue}); "
+                f"request {request.request_id} rejected"
+            )
+        self._queue.append(request)
+
+    def expire(self, tick: int) -> List[Request]:
+        """Drop queued requests whose deadline has passed. Returns them."""
+        expired = [
+            r for r in self._queue
+            if r.deadline_tick is not None and tick > r.deadline_tick
+        ]
+        if expired:
+            dead = set(id(r) for r in expired)
+            self._queue = deque(r for r in self._queue if id(r) not in dead)
+        return expired
+
+    def admit(self, free_slots: int, tick: int) -> List[Request]:
+        """FIFO-pop up to ``free_slots`` requests (policy permitting)."""
+        if free_slots <= 0 or not self._queue:
+            return []
+        if tick % self.prefill_interval != 0:
+            return []
+        n = free_slots
+        if self.max_prefill_per_tick is not None:
+            n = min(n, self.max_prefill_per_tick)
+        admitted = []
+        while self._queue and len(admitted) < n:
+            admitted.append(self._queue.popleft())
+        return admitted

@@ -1,0 +1,258 @@
+"""Front-ends for the engine: a threaded server and a deterministic driver.
+
+Two ways to turn the tick-at-a-time :class:`~gradaccum_tpu.serving.engine.
+Engine` into a request/response surface:
+
+- :class:`ServingServer` — a background thread owns the engine and runs
+  ticks; ``submit(prompt) -> StreamHandle`` is thread-safe and the handle
+  yields tokens as the engine emits them (streaming), or blocks for the
+  full result. This is the "millions of users" shape: callers never see
+  ticks, slots, or batches.
+
+- :class:`SimulationDriver` — the same traffic WITHOUT threads or wall
+  time: seeded synthetic arrival traces replayed on the logical tick
+  clock, so tests and benchmarks are bit-for-bit reproducible on CPU. The
+  engine-parity gate runs here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from gradaccum_tpu.serving.engine import Engine
+from gradaccum_tpu.serving.scheduler import QueueFull
+
+_DONE = object()  # sentinel closing a handle's token stream
+
+
+class StreamHandle:
+    """One request's streamed output. Iterate for tokens as they arrive;
+    ``result()`` blocks for the complete generation."""
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self._q: "queue.Queue" = queue.Queue()
+        self._tokens: List[int] = []
+        self._reason: Optional[str] = None
+        self._closed = threading.Event()
+        self._drained = False  # the _DONE sentinel has been consumed
+
+    def _put(self, token: int) -> None:
+        self._q.put(token)
+
+    def _finish(self, reason: str) -> None:
+        self._reason = reason
+        self._closed.set()
+        self._q.put(_DONE)
+
+    def __iter__(self):
+        while not self._drained:
+            item = self._q.get()
+            if item is _DONE:
+                self._drained = True
+                return
+            self._tokens.append(item)
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> Tuple[List[int], str]:
+        """Drain the stream; returns ``(tokens, finish_reason)``. Raises
+        TimeoutError if the request has not finished within ``timeout``
+        seconds (``None`` blocks until it does). Idempotent once finished."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._drained:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            try:
+                item = self._q.get(timeout=remaining)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"request {self.request_id} still running after "
+                    f"{timeout}s"
+                ) from None
+            if item is _DONE:
+                self._drained = True
+                break
+            self._tokens.append(item)
+        return list(self._tokens), self._reason
+
+    @property
+    def done(self) -> bool:
+        return self._closed.is_set()
+
+
+class ServingServer:
+    """Threaded front-end: one engine thread, many submitting threads."""
+
+    def __init__(self, engine: Engine, idle_sleep: float = 1e-3):
+        self._engine = engine
+        self._idle_sleep = idle_sleep
+        self._lock = threading.Lock()
+        self._handles: Dict[int, StreamHandle] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def start(self) -> "ServingServer":
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        if self._stop.is_set():
+            raise RuntimeError("server was stopped and cannot be restarted; "
+                               "build a new ServingServer around the engine")
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serving-engine")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self._abort_handles("aborted")  # in-flight requests must not hang
+        self._engine.close()
+
+    def __enter__(self) -> "ServingServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def submit(self, prompt, max_new_tokens: int, **kwargs) -> StreamHandle:
+        """Thread-safe; raises :class:`QueueFull` under backpressure and
+        RuntimeError if the engine thread has died."""
+        with self._lock:
+            # checked under the lock: _abort_handles also locks, so a
+            # handle registered here is either serviced or aborted, never
+            # stranded between the error check and registration
+            if self._error is not None:
+                raise RuntimeError(
+                    "serving engine thread died"
+                ) from self._error
+            rid = self._engine.submit(prompt, max_new_tokens, **kwargs)
+            handle = StreamHandle(rid)
+            self._handles[rid] = handle
+        return handle
+
+    def _abort_handles(self, reason: str) -> None:
+        with self._lock:
+            handles = list(self._handles.values())
+            self._handles.clear()
+        for handle in handles:
+            handle._finish(reason)
+
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    if self._engine.idle:
+                        events = None
+                    else:
+                        events = self._engine.step()
+                if events is None:
+                    self._stop.wait(self._idle_sleep)
+                    continue
+                for rid, tok in events.emitted:
+                    self._handles[rid]._put(tok)
+                for rid, reason in events.finished:
+                    handle = self._handles.pop(rid, None)
+                    if handle is not None:
+                        handle._finish(reason)
+                    self._engine.pop_result(rid)  # handle holds the tokens
+        except BaseException as e:  # a dead tick must not strand callers
+            self._error = e
+            self._abort_handles("aborted")
+            raise
+
+
+@dataclasses.dataclass
+class TraceItem:
+    """One arrival in a synthetic trace (ticks, not wall time)."""
+
+    arrival_tick: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    rng_seed: int = 0
+    deadline_ticks: Optional[int] = None
+
+
+class SimulationDriver:
+    """Replays seeded arrival traces on the logical tick clock.
+
+    Rewires the engine's metrics clock to tick counts, so TTFT/latency
+    summaries come out in TICKS — deterministic across machines. Arrivals
+    that hit queue backpressure retry on subsequent ticks (closed-loop),
+    keeping the replay deterministic under overload too.
+    """
+
+    def __init__(self, engine: Engine, seed: int = 0):
+        self.engine = engine
+        self.seed = seed
+        engine.metrics.clock = lambda: float(engine.tick_count)
+
+    def make_trace(
+        self,
+        n_requests: int,
+        vocab_size: Optional[int] = None,
+        arrival_rate: float = 0.5,
+        prompt_len: Tuple[int, int] = (1, 12),
+        max_new: Tuple[int, int] = (1, 12),
+        eos_id: Optional[int] = None,
+    ) -> List[TraceItem]:
+        """Synthetic trace: geometric inter-arrival gaps at ``arrival_rate``
+        requests/tick, uniform prompt lengths/contents and budgets."""
+        rng = np.random.default_rng(self.seed)
+        vocab = vocab_size or self.engine.cfg.vocab_size
+        items, t = [], 0
+        for i in range(n_requests):
+            t += int(rng.geometric(min(max(arrival_rate, 1e-6), 1.0))) - 1
+            n = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
+            items.append(TraceItem(
+                arrival_tick=t,
+                prompt=rng.integers(0, vocab, size=(n,)).astype(np.int32),
+                max_new_tokens=int(rng.integers(max_new[0], max_new[1] + 1)),
+                eos_id=eos_id,
+                rng_seed=i,
+            ))
+        return items
+
+    def run(self, trace: List[TraceItem], max_ticks: int = 100_000) -> List[dict]:
+        """Run to completion; returns one record per trace item:
+        ``{"request_id", "prompt", "tokens", "status"}`` in trace order."""
+        engine = self.engine
+        pending = sorted(enumerate(trace), key=lambda it: it[1].arrival_tick)
+        records: List[Optional[dict]] = [None] * len(trace)
+        ticks = 0
+        while pending or not engine.idle:
+            if ticks >= max_ticks:
+                raise RuntimeError(f"trace not drained after {max_ticks} ticks")
+            still = []
+            for idx, item in pending:
+                if item.arrival_tick > engine.tick_count:
+                    still.append((idx, item))
+                    continue
+                try:
+                    rid = engine.submit(
+                        item.prompt, item.max_new_tokens, eos_id=item.eos_id,
+                        rng_seed=item.rng_seed,
+                        deadline_ticks=item.deadline_ticks,
+                    )
+                except QueueFull:
+                    still.append((idx, item))  # backpressure: retry next tick
+                    continue
+                records[idx] = {"request_id": rid, "prompt": item.prompt}
+            pending = still
+            engine.step()
+            ticks += 1
+        for rec in records:
+            if rec is not None:
+                tokens, status = engine.pop_result(rec["request_id"])
+                rec["tokens"] = list(tokens)
+                rec["status"] = status
+        return records

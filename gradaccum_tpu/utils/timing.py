@@ -61,3 +61,35 @@ def time_device_steps(step, state, step_args, iters: int):
     if per_step <= 0:  # timing noise swamped the two-point difference
         per_step = dt_big / iters
     return per_step, state
+
+
+class LatencySeries:
+    """A scalar sample series with the summary the serving path reports
+    everywhere (mean / p50 / p99 / count). Shared by serving/metrics.py and
+    examples/bench_serving.py so every artifact quotes percentiles computed
+    the same way (numpy linear interpolation)."""
+
+    def __init__(self):
+        self._xs = []
+
+    def add(self, x: float) -> None:
+        self._xs.append(float(x))
+
+    def extend(self, xs) -> None:
+        self._xs.extend(float(x) for x in xs)
+
+    def __len__(self) -> int:
+        return len(self._xs)
+
+    def summary(self) -> dict:
+        import numpy as np
+
+        if not self._xs:
+            return {"count": 0, "mean": None, "p50": None, "p99": None}
+        a = np.asarray(self._xs, np.float64)
+        return {
+            "count": int(a.size),
+            "mean": float(a.mean()),
+            "p50": float(np.percentile(a, 50)),
+            "p99": float(np.percentile(a, 99)),
+        }

@@ -284,3 +284,109 @@ def test_generate_cached_validation(rng):
         generate_cached(params, cfg, prompt, 4, temperature=0.5)
     with pytest.raises(ValueError, match="max_position_embeddings"):
         init_cache(cfg, 1, cfg.max_position_embeddings + 1)
+
+
+def test_prefill_ragged_matches_unpadded(rng):
+    """Satellite: left-padded variable-length prompts in ONE batch must
+    produce, per row, the same compacted cache and next-token logits as
+    running each prompt unpadded on its own."""
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import prefill
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    s0, max_len = 10, 16
+    base = rng.integers(0, cfg.vocab_size, size=(3, s0)).astype(np.int32)
+    params = bundle.init(jax.random.PRNGKey(0), {"input_ids": base})
+
+    lens = np.array([10, 6, 1], np.int32)
+    padded = np.zeros((3, s0), np.int32)
+    for b, n in enumerate(lens):
+        padded[b, s0 - n:] = base[b, :n]
+
+    cache, logits = prefill(params, cfg, jnp.asarray(padded), max_len,
+                            lengths=jnp.asarray(lens))
+    assert np.array_equal(np.asarray(cache.length), lens)
+    for b, n in enumerate(lens):
+        solo_cache, solo_logits = prefill(
+            params, cfg, jnp.asarray(base[b:b + 1, :n]), max_len
+        )
+        np.testing.assert_allclose(np.asarray(logits[b]),
+                                   np.asarray(solo_logits[0]),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cache.k[:, b]),
+                                   np.asarray(solo_cache.k[:, 0]),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cache.v[:, b]),
+                                   np.asarray(solo_cache.v[:, 0]),
+                                   rtol=1e-5, atol=1e-5)
+
+    with pytest.raises(ValueError, match="lengths"):
+        prefill(params, cfg, jnp.asarray(padded), max_len,
+                lengths=jnp.asarray(lens[:2]))
+
+
+def test_decode_step_ragged_per_row_positions(rng):
+    """Each row advances at its own cache position; inactive rows are
+    untouched (no write, no length advance)."""
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import (
+        decode_step, decode_step_ragged, prefill,
+    )
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    s0, max_len = 8, 12
+    base = rng.integers(0, cfg.vocab_size, size=(2, s0)).astype(np.int32)
+    params = bundle.init(jax.random.PRNGKey(0), {"input_ids": base})
+
+    lens = np.array([8, 3], np.int32)
+    padded = np.zeros((2, s0), np.int32)
+    for b, n in enumerate(lens):
+        padded[b, s0 - n:] = base[b, :n]
+    cache, logits = prefill(params, cfg, jnp.asarray(padded), max_len,
+                            lengths=jnp.asarray(lens))
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    new_cache, step_logits = decode_step_ragged(params, cfg, cache, tok)
+    assert np.array_equal(np.asarray(new_cache.length), lens + 1)
+    for b, n in enumerate(lens):
+        solo_cache, _ = prefill(params, cfg, jnp.asarray(base[b:b + 1, :n]),
+                                max_len)
+        _, solo_logits = decode_step(params, cfg, solo_cache, tok[b:b + 1])
+        np.testing.assert_allclose(np.asarray(step_logits[b]),
+                                   np.asarray(solo_logits[0]),
+                                   rtol=1e-5, atol=1e-5)
+
+    frozen, _ = decode_step_ragged(params, cfg, cache, tok,
+                                   active=jnp.zeros((2,), bool))
+    assert np.array_equal(np.asarray(frozen.length), lens)
+    np.testing.assert_array_equal(np.asarray(frozen.k), np.asarray(cache.k))
+
+
+def test_generate_cached_top_k_one_is_greedy(rng):
+    """Satellite: top_k=1 ≡ greedy even at high temperature, and top_k
+    stays one compiled program (jit cache does not grow across calls)."""
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import _generate_jit, generate_cached
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    prompt = rng.integers(0, cfg.vocab_size, size=(2, 6)).astype(np.int32)
+    params = bundle.init(jax.random.PRNGKey(0), {"input_ids": prompt})
+
+    greedy = generate_cached(params, cfg, prompt, 8)
+    topk1 = generate_cached(params, cfg, prompt, 8, temperature=1.5,
+                            rng=jax.random.PRNGKey(5), top_k=1)
+    np.testing.assert_array_equal(np.asarray(topk1), np.asarray(greedy))
+
+    before = _generate_jit._cache_size()
+    a = generate_cached(params, cfg, prompt, 8, temperature=0.9,
+                        rng=jax.random.PRNGKey(1), top_k=4)
+    b = generate_cached(params, cfg, prompt, 8, temperature=0.9,
+                        rng=jax.random.PRNGKey(2), top_k=4)
+    assert _generate_jit._cache_size() == before + 1  # one program, two calls
+    assert not np.array_equal(np.asarray(a), np.asarray(b))  # rng matters
+
+    with pytest.raises(ValueError, match="top_k"):
+        generate_cached(params, cfg, prompt, 4, temperature=0.5,
+                        rng=jax.random.PRNGKey(0), top_k=0)

@@ -1,0 +1,373 @@
+"""Continuous-batching engine: parity, compile-once, scheduling, serving.
+
+The load-bearing test is the ENGINE PARITY GATE: under randomized seeded
+arrival traces, every request's greedy output must be token-for-token what
+``generate_cached`` produces for that prompt alone — continuous batching
+may change throughput, never results — and the decode tick must have
+compiled exactly once (the static-shape contract, checked via the jit
+cache size).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(
+        jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)}
+    )
+    return cfg, bundle, params
+
+
+# -- engine parity gate -------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_engine_greedy_parity_and_compile_once(tiny_lm, seed):
+    """≥3 seeded traces at num_slots=4: streamed greedy outputs == solo
+    generate_cached, and ONE decode program after all the churn."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=4, max_len=32)
+    driver = SimulationDriver(engine, seed=seed)
+    trace = driver.make_trace(9, arrival_rate=0.6, prompt_len=(1, 12),
+                              max_new=(1, 12))
+    records = driver.run(trace)
+
+    assert len(records) == len(trace)
+    for item, rec in zip(trace, records):
+        assert rec["status"] == "done"
+        want = generate_cached(params, cfg, item.prompt, item.max_new_tokens)
+        want_new = np.asarray(want)[0, item.prompt.size:]
+        np.testing.assert_array_equal(np.asarray(rec["tokens"]), want_new)
+
+    # the static-shape contract: no recompile after warmup, ever
+    assert engine.decode_compile_count() == 1
+    assert engine.idle
+
+
+def test_engine_parity_with_decode_block(tiny_lm):
+    """Block-scanned ticks (8 micro-steps per dispatch) change latency
+    granularity, not tokens."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=4, max_len=32, decode_block=8)
+    driver = SimulationDriver(engine, seed=7)
+    trace = driver.make_trace(8, arrival_rate=0.5, prompt_len=(1, 10),
+                              max_new=(2, 12))
+    records = driver.run(trace)
+    for item, rec in zip(trace, records):
+        want = generate_cached(params, cfg, item.prompt, item.max_new_tokens)
+        np.testing.assert_array_equal(
+            np.asarray(rec["tokens"]),
+            np.asarray(want)[0, item.prompt.size:],
+        )
+    assert engine.decode_compile_count() == 1
+
+
+def test_engine_sampled_parity(tiny_lm):
+    """Per-request rng streams: engine sampling == generate_cached with
+    the same seed, temperature, and top_k."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=3, max_len=32,
+                    temperature=0.8, top_k=5)
+    driver = SimulationDriver(engine, seed=11)
+    trace = driver.make_trace(6, arrival_rate=0.8, prompt_len=(2, 10),
+                              max_new=(3, 10))
+    records = driver.run(trace)
+    for item, rec in zip(trace, records):
+        want = generate_cached(
+            params, cfg, item.prompt, item.max_new_tokens,
+            temperature=0.8, top_k=5, rng=jax.random.PRNGKey(item.rng_seed),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(rec["tokens"]),
+            np.asarray(want)[0, item.prompt.size:],
+        )
+
+
+def test_engine_eos_retires_slot(tiny_lm):
+    """A request whose sampled token hits eos_id stops there and frees the
+    slot for the queue."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, 6).astype(np.int32)
+    # use as "eos" a continuation token whose FIRST occurrence is at k >= 1,
+    # so generation must stop exactly there (tiny models repeat tokens)
+    full = np.asarray(generate_cached(params, cfg, prompt, 8))[0, 6:]
+    k = next(i for i in range(1, len(full)) if full[i] not in full[:i])
+    eos = int(full[k])
+
+    engine = Engine(params, cfg, num_slots=1, max_len=32)
+    rid = engine.submit(prompt, 8, eos_id=eos)
+    rid2 = engine.submit(prompt, 4)  # queued behind; runs after retirement
+    engine.run_until_idle()
+    got = engine.results[rid]
+    assert got == list(full[:k + 1]), (got, full)
+    assert engine.status[rid] == "done"
+    assert engine.results[rid2] == list(full[:4])
+
+
+# -- engine bookkeeping -------------------------------------------------------
+
+
+def test_engine_submit_validation(tiny_lm):
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=16)
+    with pytest.raises(ValueError, match="exceed max_len"):
+        engine.submit(np.zeros(10, np.int32), 7)
+    with pytest.raises(ValueError, match="empty prompt"):
+        engine.submit(np.zeros(0, np.int32), 2)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        engine.submit(np.zeros(4, np.int32), 0)
+    with pytest.raises(ValueError, match="top_k"):
+        Engine(params, cfg, num_slots=2, max_len=16, temperature=0.5,
+               top_k=cfg.vocab_size + 1)
+    with pytest.raises(ValueError, match="temperature"):
+        Engine(params, cfg, num_slots=2, max_len=16, top_k=3)
+
+
+def test_engine_backpressure_and_timeout(tiny_lm):
+    from gradaccum_tpu.serving import Engine, QueueFull, Scheduler
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=1, max_len=16,
+                    scheduler=Scheduler(max_queue=3))
+    prompt = np.ones(4, np.int32)
+    engine.submit(prompt, 4)
+    engine.submit(prompt, 4)
+    engine.submit(prompt, 4, deadline_ticks=1)
+    with pytest.raises(QueueFull):
+        engine.submit(prompt, 4)
+    assert engine.metrics.rejected == 1
+
+    # the deadline_ticks=1 request can't be admitted while the first two
+    # hold the single slot, so it must expire with status "timeout"
+    rid_deadline = 2
+    engine.run_until_idle()
+    assert engine.status[rid_deadline] == "timeout"
+    assert engine.results[rid_deadline] == []
+    done = [rid for rid, s in engine.status.items() if s == "done"]
+    assert len(done) == 2
+
+
+def test_cache_pool_claim_release():
+    from gradaccum_tpu.models.gpt import GPTConfig
+    from gradaccum_tpu.serving import CachePool
+
+    cfg = GPTConfig.tiny_for_tests()
+    pool = CachePool(cfg, num_slots=2, max_len=8)
+    a, b = pool.claim(), pool.claim()
+    assert {a, b} == {0, 1}
+    assert pool.claim() is None
+    assert pool.free_count == 0 and pool.occupancy == 1.0
+    pool.release(a)
+    assert pool.free_count == 1
+    assert pool.claim() == a  # lowest slot again, deterministically
+    pool.release(a)
+    with pytest.raises(ValueError, match="not claimed"):
+        pool.release(a)
+
+
+def test_scheduler_policy_knobs():
+    from gradaccum_tpu.serving import Request, Scheduler
+
+    def req(i):
+        return Request(request_id=i, prompt=np.ones(2, np.int32),
+                       max_new_tokens=2)
+
+    s = Scheduler(max_queue=8, max_prefill_per_tick=2, prefill_interval=2)
+    for i in range(5):
+        s.submit(req(i))
+    assert s.depth == 5
+    # tick 1 is not an admission tick (interval 2)
+    assert s.admit(free_slots=4, tick=1) == []
+    got = s.admit(free_slots=4, tick=2)
+    assert [r.request_id for r in got] == [0, 1]  # FIFO, capped at 2
+    got = s.admit(free_slots=1, tick=4)
+    assert [r.request_id for r in got] == [2]  # capped by free slots
+    assert s.depth == 2
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+def test_metrics_ttft_and_throughput_on_tick_clock(tiny_lm):
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    driver = SimulationDriver(engine, seed=4)
+    trace = driver.make_trace(5, arrival_rate=0.5, prompt_len=(1, 8),
+                              max_new=(2, 8))
+    driver.run(trace)
+    m = engine.metrics.summary()
+    assert m["ttft"]["count"] == 5
+    assert m["ttft"]["p50"] is not None and m["ttft"]["p50"] >= 0
+    total = sum(item.max_new_tokens for item in trace)
+    assert m["tokens_emitted"] == total
+    assert m["finished"] == {"length": 5}
+    assert 0 < m["occupancy"]["mean"] <= 1
+    assert m["tokens_per_second"] is None or m["tokens_per_second"] > 0
+
+
+def test_metrics_events_export(tmp_path, tiny_lm):
+    """Gauges stream through the estimator EventWriter when a backend is
+    importable; without one the writer no-ops but metrics still work."""
+    from gradaccum_tpu.estimator.events import EventWriter
+    from gradaccum_tpu.serving import Engine, ServingMetrics
+
+    cfg, _, params = tiny_lm
+    writer = EventWriter(str(tmp_path))
+    metrics = ServingMetrics(event_writer=writer)
+    engine = Engine(params, cfg, num_slots=2, max_len=16, metrics=metrics)
+    engine.submit(np.ones(3, np.int32), 3)
+    engine.run_until_idle()
+    engine.close()
+    assert metrics.summary()["tokens_emitted"] == 3
+    if writer.active:  # torch tensorboard present in this container
+        import os
+
+        sub = os.path.join(str(tmp_path), "serving")
+        assert os.path.isdir(sub) and os.listdir(sub)
+
+
+# -- threaded front-end -------------------------------------------------------
+
+
+def test_server_streams_and_blocks(tiny_lm):
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(3)
+    p1 = rng.integers(0, cfg.vocab_size, 5).astype(np.int32)
+    p2 = rng.integers(0, cfg.vocab_size, 3).astype(np.int32)
+    with ServingServer(Engine(params, cfg, num_slots=2, max_len=24)) as srv:
+        h1 = srv.submit(p1, 8)
+        h2 = srv.submit(p2, 6)
+        t1, r1 = h1.result(timeout=60)
+        t2, r2 = h2.result(timeout=60)
+    assert r1 == "length" and r2 == "length"
+    w1 = np.asarray(generate_cached(params, cfg, p1, 8))[0, 5:]
+    w2 = np.asarray(generate_cached(params, cfg, p2, 6))[0, 3:]
+    np.testing.assert_array_equal(np.asarray(t1), w1)
+    np.testing.assert_array_equal(np.asarray(t2), w2)
+
+
+def test_stream_handle_timeout_and_idempotent_result(tiny_lm):
+    """result(timeout) must raise TimeoutError while the request is in
+    flight (engine thread not running), and be repeatable once done."""
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    srv = ServingServer(Engine(params, cfg, num_slots=1, max_len=16))
+    handle = srv.submit(np.ones(3, np.int32), 3)  # server NOT started
+    with pytest.raises(TimeoutError, match="still running"):
+        handle.result(timeout=0.05)
+    srv.start()
+    toks, reason = handle.result(timeout=60)
+    assert reason == "length" and len(toks) == 3
+    again, reason2 = handle.result(timeout=1)  # does not hang or re-drain
+    assert again == toks and reason2 == reason
+    srv.stop()
+
+
+def test_server_stop_aborts_inflight_handles(tiny_lm):
+    """stop() with requests still queued/running must finish their handles
+    with reason "aborted" instead of stranding blocked callers."""
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    srv = ServingServer(Engine(params, cfg, num_slots=1, max_len=16))
+    h1 = srv.submit(np.ones(3, np.int32), 4)
+    h2 = srv.submit(np.ones(3, np.int32), 4)  # queued behind h1
+    srv.stop()  # never started: nothing ran
+    _, r1 = h1.result(timeout=1)
+    _, r2 = h2.result(timeout=1)
+    assert r1 == "aborted" and r2 == "aborted"
+
+
+def test_server_rejects_when_queue_full(tiny_lm):
+    from gradaccum_tpu.serving import Engine, QueueFull, Scheduler, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=1, max_len=16,
+                    scheduler=Scheduler(max_queue=2))
+    srv = ServingServer(engine)  # not started: nothing drains the queue
+    srv.submit(np.ones(2, np.int32), 4)
+    srv.submit(np.ones(2, np.int32), 4)
+    with pytest.raises(QueueFull):
+        srv.submit(np.ones(2, np.int32), 4)
+    srv.start()
+    srv.stop()
+
+
+# -- export manifest ----------------------------------------------------------
+
+
+def test_export_manifest_records_serving_knobs(tmp_path, tiny_lm):
+    """The export manifest carries the engine's static serving shape so a
+    serving tier redeploys with the program it was benchmarked at."""
+    from gradaccum_tpu.estimator.export import export_predict, load_manifest
+    from gradaccum_tpu.serving import Engine
+
+    cfg, bundle, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=4, max_len=32, decode_block=8)
+    sample = {"input_ids": np.zeros((2, 8), np.int32)}
+    export_predict(bundle.predict, params, sample, str(tmp_path),
+                   extra=engine.manifest())
+    manifest = load_manifest(str(tmp_path))
+    assert manifest["extra"]["num_slots"] == 4
+    assert manifest["extra"]["max_len"] == 32
+    assert manifest["extra"]["decode_block"] == 8
+    assert manifest["extra"]["temperature"] == 0.0
+
+
+# -- load sweep (slow lane) ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_serving_fast_sweep(tmp_path):
+    """The bench's offered-load sweep end-to-end at --fast shapes: the JSON
+    artifact must carry every field the committed BENCH_serving.json
+    promises (platform, serial/engine legs, sweep points)."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from examples.bench_serving import main as bench_main
+
+    out = tmp_path / "BENCH_serving.json"
+    result = bench_main(["--fast", "--out", str(out)])
+    assert out.exists()
+    assert result["engine"]["decode_programs"] == 1
+    assert result["serial_tokens_per_s"] > 0
+    assert result["engine"]["tokens_per_s"] > 0
+    assert len(result["sweep"]) == 3
+    for leg in result["sweep"]:
+        assert leg["tokens_per_s"] > 0
+        assert leg["ttft_s"]["count"] > 0
+        assert 0 < leg["occupancy_mean"] <= 1
+    assert result["platform"]["backend"]
