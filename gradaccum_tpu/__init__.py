@@ -14,7 +14,16 @@ experiments.
 See SURVEY.md at the repo root for the file:line map to the reference.
 """
 
-from gradaccum_tpu import data, estimator, models, ops, parallel, serving, utils
+from gradaccum_tpu import (
+    data,
+    estimator,
+    models,
+    ops,
+    parallel,
+    resilience,
+    serving,
+    utils,
+)
 from gradaccum_tpu.ops.accumulation import (
     GradAccumConfig,
     accumulate_scan,

@@ -12,6 +12,16 @@ same guarantee holds by construction.
 Layout: ``<dir>/ckpt-<step>.msgpack`` (+ ``.tmp`` during write). Restore
 deserializes into a template pytree (``flax.serialization`` keeps arrays as
 numpy; callers jit them back to device on first use).
+
+Integrity (resilience layer): every landed file gets a sha256 entry in
+``ckpt-manifest.json``; writes retry transient OSErrors with backoff and
+sweep stale ``.tmp`` files a crashed writer left behind; ``restore`` walks
+newest→oldest, QUARANTINES anything whose checksum or deserialization
+fails (renamed to ``*.corrupt`` so it never shadows a good checkpoint
+again) and falls back to the next-oldest — a torn write costs one
+checkpoint interval, never the run. The seeded fault harness
+(:mod:`gradaccum_tpu.resilience.faults`) can kill or fail the write
+mid-file; tests/test_resilience.py replays those schedules.
 """
 
 from __future__ import annotations
@@ -25,18 +35,83 @@ from typing import Any, List, Optional, Tuple
 import jax
 from flax import serialization
 
+from gradaccum_tpu.resilience import faults, manifest
+from gradaccum_tpu.resilience.retry import retry_io
+
 _CKPT_RE = re.compile(r"ckpt-(\d+)\.msgpack$")
+_TMP_RE = re.compile(r"ckpt-\d+\.msgpack\.tmp$")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """Every checkpoint in the directory failed checksum or decode."""
+
+
+def sweep_stale_tmps(directory: str) -> List[str]:
+    """Remove ``ckpt-*.msgpack.tmp`` left by a crashed writer. Safe because
+    writes are single-threaded per directory (AsyncCheckpointer keeps one
+    in flight): any tmp present when a new write starts is dead."""
+    removed = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return removed
+    for name in names:
+        if _TMP_RE.match(name):
+            path = os.path.join(directory, name)
+            try:
+                os.remove(path)
+                removed.append(path)
+            except OSError:
+                pass  # best-effort: a vanished tmp is the goal anyway
+    return removed
+
+
+def _quarantine(directory: str, path: str, reason: str) -> None:
+    """Move a bad checkpoint aside (``*.corrupt``) so the newest-first scan
+    never trips on it again, and drop its manifest entry."""
+    target = path + ".corrupt"
+    try:
+        os.replace(path, target)
+    except OSError:
+        try:
+            os.remove(path)
+        except OSError:
+            return  # cannot touch it; restore will keep skipping it by name
+    manifest.forget(directory, os.path.basename(path))
+    print(f"[ckpt] quarantined {os.path.basename(path)}: {reason}")
 
 
 def _encode_and_write(directory: str, host_state: Any, step: int, keep: int) -> str:
     path = os.path.join(directory, f"ckpt-{step}.msgpack")
     tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(serialization.to_bytes(host_state))
-    os.replace(tmp, path)
+    sweep_stale_tmps(directory)
+    data = serialization.to_bytes(host_state)
+
+    def write():
+        with open(tmp, "wb") as f:
+            mid = len(data) // 2
+            f.write(data[:mid])
+            # a "crash" here leaves a truncated tmp (the sweep's job); an
+            # "io_error" exercises the retry loop around this closure
+            faults.fire(faults.MID_CKPT_WRITE, step)
+            f.write(data[mid:])
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    retry_io(write)
+    pruned = []
     if keep:
         for _, old in all_checkpoints(directory)[:-keep]:
-            os.remove(old)
+            try:
+                os.remove(old)
+            except OSError:
+                continue  # still on disk: keep its checksum entry too
+            pruned.append(os.path.basename(old))
+    # one manifest load+rewrite per save, not O(keep): record the new file
+    # and forget every pruned one together
+    manifest.apply(directory, record_entry=(os.path.basename(path), data),
+                   forget_names=pruned)
     return path
 
 
@@ -68,7 +143,13 @@ class AsyncCheckpointer:
         os.makedirs(directory, exist_ok=True)
         with self._lock:
             if self._pending is not None:
-                self._pending.result()  # surface errors; keep one in flight
+                try:
+                    # surface errors; keep one in flight. The failed future
+                    # must clear even when this raises, or one bad write
+                    # would re-raise the same stale error on every save
+                    self._pending.result()
+                finally:
+                    self._pending = None
             host_state = jax.device_get(state)
             self._pending = self._pool.submit(
                 _encode_and_write, directory, host_state, step, keep
@@ -78,12 +159,16 @@ class AsyncCheckpointer:
         """Block until the in-flight write (if any) has landed on disk."""
         with self._lock:
             if self._pending is not None:
-                self._pending.result()
-                self._pending = None
+                try:
+                    self._pending.result()
+                finally:
+                    self._pending = None  # a failed write is done failing
 
     def close(self) -> None:
-        self.wait()
-        self._pool.shutdown(wait=True)
+        try:
+            self.wait()  # surfaces a failed in-flight write exactly once
+        finally:
+            self._pool.shutdown(wait=True)
 
 
 def all_checkpoints(directory: str) -> List[Tuple[int, str]]:
@@ -103,19 +188,82 @@ def latest_checkpoint(directory: str) -> Optional[Tuple[int, str]]:
     return ckpts[-1] if ckpts else None
 
 
+def _try_load(directory: str, path: str, template: Any):
+    """Deserialize one candidate. Returns the state, or None to fall back.
+
+    Quarantine (destructive rename) is reserved for PROVEN corruption — a
+    checksum mismatch against the manifest, or an unreadable file. A file
+    whose checksum verifies but which still fails to deserialize is intact
+    on disk: that is a template/schema mismatch (wrong state shape, code
+    drift), and renaming healthy checkpoints over a software bug would
+    mutilate hours of optimizer state — raise loudly instead. A file with
+    no manifest entry (pre-manifest directories) that fails to decode is
+    skipped WITHOUT renaming: corruption cannot be proven, so nothing is
+    destroyed.
+    """
+    def read():
+        with open(path, "rb") as f:
+            return f.read()
+
+    try:
+        # reads deserve the same transient-IO grace as writes — but a
+        # vanished file (pruned/quarantined concurrently) is permanent, so
+        # don't burn backoff sleeps on it
+        data = retry_io(read, give_up_on=(FileNotFoundError,))
+    except OSError as e:
+        # an unreadable file is not PROVEN corrupt (stale NFS handle, EIO
+        # blip): skip to an older checkpoint, destroy nothing
+        print(f"[ckpt] skipping {os.path.basename(path)} (unreadable "
+              f"after retries: {e})")
+        return None
+    verdict = manifest.verify_bytes(directory, os.path.basename(path), data)
+    if verdict is False:
+        _quarantine(directory, path, "checksum mismatch")
+        return None
+    try:
+        return serialization.from_bytes(template, data)
+    except Exception as e:  # truncated/garbled msgpack, wrong tree
+        if verdict is True:
+            raise CheckpointCorruptError(
+                f"{path} verifies against the manifest but does not "
+                f"deserialize into the given template — a state-schema/"
+                f"template mismatch, not disk corruption (file left "
+                f"untouched): {e}"
+            ) from e
+        print(f"[ckpt] skipping {os.path.basename(path)} "
+              f"(no checksum on record, undeserializable: {e})")
+        return None
+
+
 def restore(directory_or_path: str, template: Any) -> Any:
     """Restore the newest checkpoint (or an explicit file) into ``template``.
 
     Raises FileNotFoundError when the directory holds no checkpoints — the
     caller decides whether cold-start is acceptable (Estimator does, matching
-    the reference's fresh-model_dir behavior).
+    the reference's fresh-model_dir behavior). A corrupt or truncated newest
+    checkpoint is quarantined and the next-oldest restored instead;
+    :class:`CheckpointCorruptError` only when every candidate fails. An
+    EXPLICIT file path never falls back — the caller named that file, so a
+    bad one is an error, not a detour.
     """
     if os.path.isfile(directory_or_path):
         path = directory_or_path
-    else:
-        found = latest_checkpoint(directory_or_path)
-        if found is None:
-            raise FileNotFoundError(f"no checkpoints under {directory_or_path}")
-        _, path = found
-    with open(path, "rb") as f:
-        return serialization.from_bytes(template, f.read())
+        directory = os.path.dirname(path) or "."
+        with open(path, "rb") as f:
+            data = f.read()
+        if manifest.verify_bytes(directory, os.path.basename(path),
+                                 data) is False:
+            raise CheckpointCorruptError(f"checksum mismatch for {path}")
+        return serialization.from_bytes(template, data)
+    candidates = all_checkpoints(directory_or_path)
+    if not candidates:
+        raise FileNotFoundError(f"no checkpoints under {directory_or_path}")
+    for _, path in reversed(candidates):
+        state = _try_load(directory_or_path, path, template)
+        if state is not None:
+            return state
+    raise CheckpointCorruptError(
+        f"all {len(candidates)} checkpoints under {directory_or_path} "
+        f"failed to restore (corrupt files quarantined as *.corrupt; "
+        f"unproven ones left in place)"
+    )

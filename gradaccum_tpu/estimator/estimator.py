@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import time
+import weakref
 from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional
 
 import jax
@@ -33,6 +34,41 @@ from gradaccum_tpu.ops import accumulation as acc
 from gradaccum_tpu.ops.adamw import Optimizer
 from gradaccum_tpu.parallel.dp import make_dp_train_step
 from gradaccum_tpu.parallel.sharding import device_put_batch
+from gradaccum_tpu.resilience import faults, preemption
+
+
+class _Resources:
+    """Background resources (async checkpoint writer, event writer) in a
+    holder the atexit-safe finalizer can close WITHOUT a reference back to
+    the Estimator — ``weakref.finalize`` runs at GC or interpreter exit,
+    replacing the old broad-``except`` ``__del__`` (which silently ate
+    errors and could resurrect a half-torn-down instance at shutdown)."""
+
+    __slots__ = ("async_ckpt", "events")
+
+    def __init__(self):
+        self.async_ckpt = None
+        self.events = None
+
+
+def _close_resources(res: _Resources) -> None:
+    """Drain + close both resources; raises the checkpoint error (the one
+    that can lose data) after the event writer is down too."""
+    ckpt, res.async_ckpt = res.async_ckpt, None
+    ev, res.events = res.events, None
+    try:
+        if ckpt is not None:
+            ckpt.close()
+    finally:
+        if ev is not None:
+            ev.close()
+
+
+def _finalize_quietly(res: _Resources) -> None:
+    try:
+        _close_resources(res)
+    except Exception:
+        pass  # interpreter shutdown / GC: best-effort only
 
 
 class ModelBundle(NamedTuple):
@@ -187,6 +223,14 @@ class Estimator:
                     "sparse_embed composes with the scan/DP/GSPMD paths, "
                     "not 'seq' axis or pipeline"
                 )
+        if accum.skip_nonfinite and (
+            pipeline is not None or self._sp_active or sparse_embed
+        ):
+            raise ValueError(
+                "skip_nonfinite runs on the streaming/scan no-mesh, DP and "
+                "GSPMD paths; the pipeline / 'seq'-axis / sparse_embed "
+                "steps do not implement the guarded accumulator"
+            )
         self.model = model
         self.optimizer = optimizer
         self.accum = accum
@@ -203,18 +247,21 @@ class Estimator:
         self._eval_step = None
         self._predict_fn = None
         self._state = None  # last trained/restored state
-        self._events = None  # lazy TensorBoard event writer (events.py)
-        self._async_ckpt = None  # lazy AsyncCheckpointer (async_checkpoint)
+        # lazy EventWriter + AsyncCheckpointer live in a holder so the
+        # atexit-safe finalizer can drain them without keeping self alive
+        self._res = _Resources()
+        self._finalizer = weakref.finalize(self, _finalize_quietly, self._res)
         self._peak_flops = None  # lazy mesh-wide bf16 peak (see _mfu)
+        self.nonfinite_skips = 0  # micro-batches skipped by skip_nonfinite
 
     def _ckpt_save(self, state, step_no):
         """Route through the async writer when configured — training only
         blocks on device→host transfer, not msgpack encode + disk IO."""
         cfg = self.config
         if cfg.async_checkpoint:
-            if self._async_ckpt is None:
-                self._async_ckpt = ckpt_lib.AsyncCheckpointer()
-            self._async_ckpt.save(
+            if self._res.async_ckpt is None:
+                self._res.async_ckpt = ckpt_lib.AsyncCheckpointer()
+            self._res.async_ckpt.save(
                 cfg.model_dir, state, step_no, cfg.keep_checkpoint_max
             )
         else:
@@ -223,35 +270,27 @@ class Estimator:
     def _ckpt_sync(self):
         """Wait for any in-flight async write (call before reading the
         newest checkpoint and before trusting durability at exit)."""
-        if self._async_ckpt is not None:
-            self._async_ckpt.wait()
+        if self._res.async_ckpt is not None:
+            self._res.async_ckpt.wait()
 
     def close(self):
         """Release background resources — the event-writer thread/file and
-        the async checkpoint worker. Safe to call repeatedly; later API
-        calls recreate both lazily."""
-        if self._async_ckpt is not None:
-            self._async_ckpt.close()
-            self._async_ckpt = None
-        if self._events is not None:
-            self._events.close()
-            self._events = None
-
-    def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass  # interpreter shutdown: best-effort only
+        the async checkpoint worker (draining its in-flight write, so the
+        last checkpoint lands). Safe to call repeatedly; later API calls
+        recreate both lazily. Also runs automatically on any exception out
+        of ``train`` and — best-effort, via an atexit-safe finalizer — at
+        GC/interpreter exit."""
+        _close_resources(self._res)
 
     @property
     def events(self):
         """TensorBoard writer rooted at model_dir (no-op without a backend
         or without a model_dir) — the reference's implicit summaries."""
-        if self._events is None:
+        if self._res.events is None:
             from gradaccum_tpu.estimator.events import EventWriter
 
-            self._events = EventWriter(self.config.model_dir)
-        return self._events
+            self._res.events = EventWriter(self.config.model_dir)
+        return self._res.events
 
     # -- state ----------------------------------------------------------
 
@@ -519,6 +558,8 @@ class Estimator:
         steps_at_t0 = step_no
         last_logged_bucket = step_no // log_every
         loss_rows = []  # (step, device scalar) — fetched lazily at flushes
+        skip_rows = []  # device scalars from aux["skipped"] (skip_nonfinite)
+        self.nonfinite_skips = 0
         micro_size = None
         last_saved = None
 
@@ -536,10 +577,21 @@ class Estimator:
                     [(s, float(v)) for s, v in jax.device_get(loss_rows)]
                 )
                 loss_rows.clear()
+            if skip_rows:
+                self.nonfinite_skips += int(
+                    sum(int(v) for v in jax.device_get(skip_rows))
+                )
+                skip_rows.clear()
+                if cfg.model_dir:
+                    # cumulative count: a flat line means a healthy run
+                    self.events.scalar(
+                        "nonfinite_skips", self.nonfinite_skips, step_no
+                    )
 
         def flush(save_ckpt: bool):
             nonlocal last_saved
             if not cfg.model_dir:
+                flush_loss_rows()  # still folds skip counts into the total
                 return
             if save_ckpt and last_saved != step_no:
                 self._ckpt_save(state, step_no)
@@ -551,16 +603,41 @@ class Estimator:
                 # scan mode consumes whole K-cycles: stop before overshooting
                 if max_steps is not None and step_no + k > max_steps:
                     break
+                if preemption.requested():
+                    # SIGTERM / preemption: break to the normal final-save
+                    # path below — it writes a checkpoint at this exact
+                    # micro-step and drains the async writer, so the
+                    # resumed job continues bitwise from here. Acknowledge
+                    # ONLY when this call owns the final save; with
+                    # final_save=False the caller (train_and_evaluate)
+                    # still needs to see the flag to save and stop.
+                    if final_save:
+                        preemption.acknowledge()
+                    print(f"[train] preemption requested; stopping at "
+                          f"step={step_no}"
+                          + (" after final checkpoint" if final_save else ""))
+                    break
                 batch = pending if pending is not None else next(it, None)
                 pending = None
                 if batch is None:
                     break
                 if micro_size is None:
                     micro_size = self._micro_size(batch)
+                # seeded fault points (no-ops unless an injector is
+                # installed): PRE may also poison the batch (nan/inf kinds)
+                # to drive the compiled step's non-finite skip path
+                kind = faults.fire(faults.PRE_TRAIN_STEP, step_no)
+                if kind is not None:
+                    batch = faults.corrupt_batch(batch, kind)
                 # observe pre-dispatch: the window always traces >=1 step
                 profiler.observe(step_no)
                 state, aux = step_fn(state, *self._prep_batch(batch, step_no))
                 step_no += k
+                faults.fire(faults.POST_TRAIN_STEP, step_no)
+                if "skipped" in aux:
+                    skip_rows.append(aux["skipped"])
+                    if len(skip_rows) >= 4096:  # same cap as loss_rows —
+                        flush_loss_rows()       # runs without a model_dir too
                 if cfg.model_dir:
                     loss_rows.append((step_no, aux["loss"]))
                     if len(loss_rows) >= 4096:  # hard cap for huge log cadences
@@ -585,6 +662,16 @@ class Estimator:
                     and step_no % cfg.save_checkpoints_steps < k
                 ):
                     flush(save_ckpt=True)
+        except BaseException:
+            # a crash mid-train must still land the last checkpoint: drain
+            # and close the async writer (and the event files). close() is
+            # repeat-safe and later API calls recreate both lazily, so a
+            # caller that catches and resumes loses nothing.
+            try:
+                self.close()
+            except Exception:
+                pass  # the original exception is the story
+            raise
         finally:
             # an exception mid-window must still stop the process-global
             # profiler (and flush its trace)
@@ -754,6 +841,18 @@ class Estimator:
                 final_save=False,  # periodic cadence only; final save below
             )
             done_steps = int(jax.device_get(state.step))
+            if preemption.requested():
+                # the chunked train() left the flag for us (final_save was
+                # False, so no checkpoint landed there): save NOW, drain,
+                # and stop — the grace window is for checkpointing, not
+                # for finishing the schedule or running one more eval
+                preemption.acknowledge()
+                if self.config.model_dir:
+                    self._ckpt_save(state, done_steps)
+                    self._ckpt_sync()
+                print(f"[train_and_evaluate] preemption: final checkpoint "
+                      f"at step={done_steps}; stopping")
+                return state, results
             peeked = next(it, None)
             if peeked is not None:
                 it = itertools.chain([peeked], it)

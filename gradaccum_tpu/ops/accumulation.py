@@ -75,6 +75,18 @@ class GradAccumConfig(NamedTuple):
     # order; fusion can still change f32 rounding at the ULP level. K x the
     # step's code size. True unrolls fully.
     unroll: Any = 1
+    # Robustness (resilience layer): detect non-finite loss/gradients INSIDE
+    # the compiled step and skip the bad micro-batch's contribution — its
+    # gradient is replaced by zeros before touching the accumulator, so the
+    # accumulation window is never corrupted; the denominator stays K (a bad
+    # micro-batch conservatively shrinks the update instead of rescaling
+    # it). If EVERY micro-batch in the window is bad the optimizer apply is
+    # skipped entirely (params and moments bitwise unchanged). aux gains a
+    # "skipped" count the Estimator surfaces via EventWriter. Off by
+    # default: when all inputs are finite the math (and the compiled HLO's
+    # numerics) match the unguarded path exactly, but the extra isfinite
+    # reductions are not free.
+    skip_nonfinite: bool = False
 
 
 # loss_fn(params, micro_batch) -> scalar loss (mean over the micro batch).
@@ -87,6 +99,27 @@ def _with_rng(batch, key):
     if not isinstance(batch, dict):
         raise TypeError("needs_rng requires dict batches (to carry the 'rng' key)")
     return dict(batch, rng=key)
+
+
+def _grads_finite(grads, init):
+    """AND ``init`` with every gradient leaf being finite."""
+    ok = init
+    for leaf in jax.tree.leaves(grads):
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
+def _all_finite(loss, grads):
+    """Scalar bool: the micro-batch produced a usable gradient."""
+    return _grads_finite(grads, jnp.isfinite(loss))
+
+
+def _zero_if_bad(grads, good):
+    """Replace the whole gradient tree with zeros when ``good`` is False —
+    the skip must never let a NaN/Inf reach the accumulator."""
+    return jax.tree.map(
+        lambda g: jnp.where(good, g, jnp.zeros_like(g)), grads
+    )
 
 
 def _finalize(grads, config: GradAccumConfig, denom):
@@ -172,34 +205,74 @@ def accumulate_scan(
         else:
             xs = (super_batch, None)
 
-        def body(accum, x):
+        skip = config.skip_nonfinite
+
+        def body(carry, x):
+            accum, n_good = carry
             micro_batch, key = x
             if key is not None:
                 micro_batch = _with_rng(micro_batch, key)
             loss, grads = grad_fn(diff_params, micro_batch)
+            if skip:
+                good = _all_finite(loss, grads)
+                grads = _zero_if_bad(grads, good)
+                loss = jnp.where(good, loss, 0.0)  # masked out of the mean
+                n_good = n_good + good.astype(jnp.int32)
             accum = jax.tree.map(jnp.add, accum, grads)
-            return accum, loss
+            return (accum, n_good), loss
 
-        accum0 = tree_zeros_like(diff_params)
-        accum, losses = lax.scan(body, accum0, xs, length=k,
-                                 unroll=config.unroll)
+        carry0 = (tree_zeros_like(diff_params), jnp.zeros((), jnp.int32))
+        (accum, n_good), losses = lax.scan(body, carry0, xs, length=k,
+                                           unroll=config.unroll)
         if axis is not None:
             accum = lax.psum(accum, axis)  # the one collective per update
             denom = k * lax.axis_size(axis)
+            if skip:
+                n_good = lax.psum(n_good, axis)
         else:
             denom = k
         grads, norm = _finalize(accum, config, denom)
         apply_step = state.step + k
-        new_params, new_opt_state = optimizer.update(
-            grads, state.opt_state, state.params, apply_step
-        )
+        if skip:
+            # denom stays K(*N): a skipped micro-batch contributes zero, so
+            # the update shrinks instead of rescaling — and an all-bad
+            # window must not apply at all (AdamW would still decay and
+            # advance moments on a zero gradient).
+            new_params, new_opt_state = lax.cond(
+                n_good > 0,
+                lambda _: optimizer.update(
+                    grads, state.opt_state, state.params, apply_step
+                ),
+                lambda _: (state.params, state.opt_state),
+                None,
+            )
+        else:
+            new_params, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params, apply_step
+            )
         new_state = ScanState(
             params=new_params, opt_state=new_opt_state, step=apply_step
         )
-        loss = jnp.mean(losses)
-        if config.axis_name is not None:
-            loss = lax.pmean(loss, config.axis_name)
-        return new_state, {"loss": loss, "grad_norm": norm, "lr_step": apply_step}
+        if skip:
+            # logged loss = mean over USABLE micro-batches, across replicas
+            # (a NaN loss must not poison the window's logging); NaN only
+            # when the entire window was bad — which the log should show.
+            loss_sum = jnp.sum(losses)
+            if axis is not None:
+                loss_sum = lax.psum(loss_sum, axis)
+            loss = jnp.where(
+                n_good > 0,
+                loss_sum / jnp.maximum(n_good.astype(losses.dtype), 1.0),
+                jnp.nan,
+            )
+        else:
+            loss = jnp.mean(losses)
+            if axis is not None:
+                loss = lax.pmean(loss, axis)
+        aux = {"loss": loss, "grad_norm": norm, "lr_step": apply_step}
+        if skip:
+            aux["skipped"] = jnp.int32(denom) - n_good  # window-global count
+        return new_state, aux
 
     return train_step
 
@@ -223,6 +296,12 @@ class StreamingState(NamedTuple):
     opt_state: Any
     accum_grads: Any  # the reference's accum_grads variables (optimization.py:78)
     step: jnp.ndarray  # micro-batch counter == reference global_step
+    # usable micro-batches accumulated in the current window — persistent
+    # state (like accum_grads) because streaming windows span host steps.
+    # Only consulted by skip_nonfinite (an all-bad window must skip the
+    # optimizer apply, not run it on a zero gradient); checkpointed with
+    # the rest of the state so the guard survives resume too.
+    good_count: jnp.ndarray
 
 
 def streaming_init(params, optimizer: Optimizer) -> StreamingState:
@@ -231,6 +310,7 @@ def streaming_init(params, optimizer: Optimizer) -> StreamingState:
         opt_state=optimizer.init(params),
         accum_grads=tree_zeros_like(params),
         step=jnp.zeros((), dtype=jnp.int32),
+        good_count=jnp.zeros((), dtype=jnp.int32),
     )
 
 
@@ -272,32 +352,72 @@ def streaming_step(
         # model (one aggregation per micro-batch assign_add). The 1/N
         # (04:46's loss scaling) folds into the apply-time denominator.
         loss, grads = grad_fn(state.params, micro_batch)
+        skip = config.skip_nonfinite
+        if skip:
+            # a non-finite micro-batch contributes ZEROS to the persistent
+            # accumulators — the window survives; denom stays K so the
+            # eventual update shrinks rather than rescales. Under shard_map
+            # the gradient auto-psum already merged replicas (grads are
+            # axis-invariant), but the LOSS is replica-local — the skip
+            # decision must be made invariant explicitly (pmin: any
+            # replica's non-finite loss skips the micro-batch everywhere)
+            # or the zeroed-grad accumulators would diverge across
+            # replicas.
+            finite_loss = jnp.isfinite(loss)
+            if axis is not None:
+                finite_loss = (
+                    lax.pmin(finite_loss.astype(jnp.int32), axis) > 0
+                )
+            good = _grads_finite(grads, finite_loss)
+            grads = _zero_if_bad(grads, good)
+            good_inc = good.astype(jnp.int32)
+            # aux loss stays the RAW per-micro-batch value: a NaN row in
+            # the log marks the skipped micro-batch. (The scan path's
+            # masking applies to window MEANS — at micro-batch granularity
+            # a skipped batch has no usable loss to substitute.)
         apply_denom = k * (lax.axis_size(axis) if axis is not None else 1)
 
         def apply_branch(operand):
-            params, opt_state, accum = operand
+            params, opt_state, accum, n_good = operand
             # (a) re-accumulate the current grad first (optimization.py:81)
             accum = jax.tree.map(jnp.add, accum, grads)
             # (b)-(c) normalize, cross-replica mean, clip (optimization.py:83-84)
             avg, _ = _finalize(accum, config, apply_denom)
             # (d) apply (optimization.py:85); schedule sees the micro-batch step
-            new_params, new_opt_state = optimizer.update(
-                avg, opt_state, params, state.step + step_offset
-            )
-            # (e) zero the accumulators (optimization.py:87)
-            return new_params, new_opt_state, tree_zeros_like(accum)
+            sched_step = state.step + step_offset
+            if skip:
+                # an all-bad window must not apply at all (AdamW would
+                # still decay params and advance moments on a zero grad)
+                new_params, new_opt_state = lax.cond(
+                    n_good + good_inc > 0,
+                    lambda _: optimizer.update(avg, opt_state, params,
+                                               sched_step),
+                    lambda _: (params, opt_state),
+                    None,
+                )
+            else:
+                new_params, new_opt_state = optimizer.update(
+                    avg, opt_state, params, sched_step
+                )
+            # (e) zero the accumulators (optimization.py:87) + the window's
+            # good-count
+            return (new_params, new_opt_state, tree_zeros_like(accum),
+                    jnp.zeros((), jnp.int32))
 
         def accumulate_branch(operand):
-            params, opt_state, accum = operand
+            params, opt_state, accum, n_good = operand
             accum = jax.tree.map(jnp.add, accum, grads)
-            return params, opt_state, accum
+            if skip:
+                n_good = n_good + good_inc
+            return params, opt_state, accum, n_good
 
         applied = (state.step % k) == phase
-        new_params, new_opt_state, new_accum = lax.cond(
+        new_params, new_opt_state, new_accum, new_good = lax.cond(
             applied,
             apply_branch,
             accumulate_branch,
-            (state.params, state.opt_state, state.accum_grads),
+            (state.params, state.opt_state, state.accum_grads,
+             state.good_count),
         )
         # Unconditional micro-batch bump (optimization.py:102-103).
         new_state = StreamingState(
@@ -305,13 +425,17 @@ def streaming_step(
             opt_state=new_opt_state,
             accum_grads=new_accum,
             step=state.step + 1,
+            good_count=new_good,
         )
         # aux loss is replica-local on purpose (the gradient auto-psum is the
         # only collective this step emits); the DP wrapper pmeans it for
         # logging, single-device callers use it as-is.
-        return new_state, {
+        aux = {
             "loss": loss,
             "applied": applied.astype(jnp.float32),
         }
+        if config.skip_nonfinite:
+            aux["skipped"] = jnp.int32(1) - good.astype(jnp.int32)
+        return new_state, aux
 
     return train_step

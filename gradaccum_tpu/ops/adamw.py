@@ -50,6 +50,18 @@ class AdamState(NamedTuple):
     v: Any
 
 
+class AdamBCState(NamedTuple):
+    """Bias-corrected Adam state. Module-level on purpose: two ``adam()``
+    instances must produce pytree-COMPATIBLE states (same node class), or a
+    state built by one cannot flow through ``lax.cond``/``tree.map`` next
+    to a state built by another (e.g. a checkpoint template vs the live
+    optimizer in the resilience layer's skip-update branch)."""
+
+    t: jnp.ndarray
+    m: Any
+    v: Any
+
+
 def _leafwise(arity: int, fn, params, *trees):
     """Map ``fn(param_leaf, *other_leaves) -> arity-tuple`` over zipped trees.
 
@@ -132,11 +144,6 @@ def adam(
     micro-batch step counter, so it lives in the optimizer state.
     """
     schedule = as_schedule(learning_rate)
-
-    class AdamBCState(NamedTuple):
-        t: jnp.ndarray
-        m: Any
-        v: Any
 
     def init(params):
         return AdamBCState(

@@ -1,0 +1,52 @@
+"""Fault tolerance: seeded fault injection and the machinery that survives it.
+
+The paper's central guarantee — accumulation state lives in ordinary
+checkpointed variables, so resume mid-accumulation-cycle is exact — is only
+worth anything if the process can actually die and come back. This package
+supplies both halves of that proof:
+
+- :mod:`faults` — a deterministic, seeded fault-injection harness. Crash
+  points (pre/post train-step, mid-checkpoint-write, mid-decode-tick),
+  injectable NaN/Inf batches and IO errors, all driven by a seeded schedule
+  so every failure replays exactly. Zero overhead when nothing is installed.
+- :mod:`manifest` — per-file sha256 checksum manifest for checkpoint
+  directories; corrupt files are detected at restore time and quarantined.
+- :mod:`retry` — bounded retry-with-backoff for transient IO.
+- :mod:`watchdog` — a stall detector for the serving engine's tick loop.
+- :mod:`preemption` — SIGTERM handling so a preempted trainer drains its
+  async checkpoint writer and lands one final checkpoint.
+
+The consumers live in :mod:`gradaccum_tpu.estimator` (non-finite-gradient
+skip, checkpoint integrity, graceful shutdown) and
+:mod:`gradaccum_tpu.serving` (engine-fault recovery, request requeue,
+watchdog); the headline test (tests/test_resilience.py) kills training at a
+seeded step inside an accumulation window and asserts the resumed
+loss/param trajectory is bitwise identical to the uninterrupted run.
+"""
+
+from gradaccum_tpu.resilience import faults, manifest, preemption, retry
+from gradaccum_tpu.resilience.faults import (
+    FaultInjector,
+    FaultSchedule,
+    FaultSpec,
+    InjectedCrash,
+    InjectedIOError,
+)
+from gradaccum_tpu.resilience.preemption import PreemptionHandler
+from gradaccum_tpu.resilience.retry import retry_io
+from gradaccum_tpu.resilience.watchdog import Watchdog
+
+__all__ = [
+    "faults",
+    "manifest",
+    "preemption",
+    "retry",
+    "FaultInjector",
+    "FaultSchedule",
+    "FaultSpec",
+    "InjectedCrash",
+    "InjectedIOError",
+    "PreemptionHandler",
+    "retry_io",
+    "Watchdog",
+]

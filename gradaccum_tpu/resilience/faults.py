@@ -1,0 +1,205 @@
+"""Deterministic, seeded fault injection.
+
+A :class:`FaultSchedule` is a list of :class:`FaultSpec` — *fire fault KIND
+at POINT when the caller's index equals AT* — optionally generated from a
+seed so a failure scenario replays exactly. A :class:`FaultInjector` holds
+a schedule plus the log of what actually fired; installing it (module
+global, or the :func:`installed` context manager) arms the hooks that the
+training loop, checkpoint writer, and serving engine already call.
+
+Fault points (the ``index`` each site passes):
+
+- ``PRE_TRAIN_STEP`` — before dispatching a train step; index = micro-batch
+  step count *before* the step. The only point where data-corruption kinds
+  (``nan``/``inf``) apply: the batch is poisoned host-side so the compiled
+  step sees genuinely non-finite gradients.
+- ``POST_TRAIN_STEP`` — after a train step returned; index = step count
+  *after* the step (micro-batches consumed).
+- ``MID_CKPT_WRITE`` — between the two halves of a checkpoint tmp-file
+  write; index = checkpoint step. ``crash`` leaves a truncated ``.tmp``
+  (the sweep test), ``io_error`` exercises retry-with-backoff.
+- ``MID_DECODE_TICK`` — inside the serving engine's tick, after admission
+  and before the decode dispatch; index = tick count.
+
+Kinds: ``crash`` raises :class:`InjectedCrash` (simulated process death —
+deliberately NOT an OSError, so IO retry loops never swallow it);
+``io_error`` raises :class:`InjectedIOError` (an OSError, so retry paths
+treat it as a real transient failure); ``nan``/``inf`` return the kind
+string for the call site to apply via :func:`corrupt_batch`.
+
+When no injector is installed every hook is one global load + compare —
+nothing here touches the hot path in production.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+PRE_TRAIN_STEP = "pre_train_step"
+POST_TRAIN_STEP = "post_train_step"
+MID_CKPT_WRITE = "mid_checkpoint_write"
+MID_DECODE_TICK = "mid_decode_tick"
+POINTS = (PRE_TRAIN_STEP, POST_TRAIN_STEP, MID_CKPT_WRITE, MID_DECODE_TICK)
+
+KIND_CRASH = "crash"
+KIND_IO_ERROR = "io_error"
+KIND_NAN = "nan"
+KIND_INF = "inf"
+KINDS = (KIND_CRASH, KIND_IO_ERROR, KIND_NAN, KIND_INF)
+
+
+class InjectedCrash(RuntimeError):
+    """Simulated process death at a fault point."""
+
+    def __init__(self, point: str, index: int):
+        super().__init__(f"injected crash at {point} index={index}")
+        self.point = point
+        self.index = index
+
+
+class InjectedIOError(OSError):
+    """Simulated transient IO failure (an OSError: retry paths retry it)."""
+
+    def __init__(self, point: str, index: int):
+        super().__init__(f"injected IO error at {point} index={index}")
+        self.point = point
+        self.index = index
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """Fire ``kind`` at ``point`` when the call-site index equals ``at``.
+
+    ``at=None`` matches ANY index (e.g. "every decode tick"). ``count`` is
+    how many firings this spec is good for — an ``io_error`` with
+    ``count=2`` fails the first two attempts and lets the third retry
+    succeed.
+    """
+
+    point: str
+    at: Optional[int]
+    kind: str = KIND_CRASH
+    count: int = 1
+
+    def __post_init__(self):
+        if self.point not in POINTS:
+            raise ValueError(f"unknown fault point {self.point!r}; one of {POINTS}")
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; one of {KINDS}")
+        if self.count < 1:
+            raise ValueError(f"count must be >= 1, got {self.count}")
+
+
+class FaultSchedule:
+    """An ordered fault plan with per-spec remaining-firing budgets."""
+
+    def __init__(self, specs: Sequence[FaultSpec]):
+        self.specs = list(specs)
+        self._remaining = [s.count for s in self.specs]
+
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        n_faults: int = 1,
+        points: Sequence[str] = POINTS,
+        kinds: Sequence[str] = (KIND_CRASH,),
+        index_range: Tuple[int, int] = (0, 100),
+    ) -> "FaultSchedule":
+        """A deterministic random plan: same seed, same faults, every time."""
+        rng = np.random.default_rng(seed)
+        specs = []
+        for _ in range(n_faults):
+            specs.append(FaultSpec(
+                point=points[int(rng.integers(len(points)))],
+                at=int(rng.integers(index_range[0], index_range[1])),
+                kind=kinds[int(rng.integers(len(kinds)))],
+            ))
+        return cls(specs)
+
+    def match(self, point: str, index: int) -> Optional[FaultSpec]:
+        """Consume and return the first armed spec matching (point, index)."""
+        for i, spec in enumerate(self.specs):
+            if self._remaining[i] <= 0 or spec.point != point:
+                continue
+            if spec.at is not None and spec.at != index:
+                continue
+            self._remaining[i] -= 1
+            return spec
+        return None
+
+
+class FaultInjector:
+    """A schedule plus the log of what fired (for assertions in tests)."""
+
+    def __init__(self, schedule: FaultSchedule):
+        self.schedule = schedule
+        self.fired: List[Tuple[str, int, str]] = []  # (point, index, kind)
+        self._lock = threading.Lock()  # ckpt writer + engine threads both fire
+
+    def fire(self, point: str, index: int) -> Optional[str]:
+        with self._lock:
+            spec = self.schedule.match(point, index)
+            if spec is None:
+                return None
+            self.fired.append((point, index, spec.kind))
+        if spec.kind == KIND_CRASH:
+            raise InjectedCrash(point, index)
+        if spec.kind == KIND_IO_ERROR:
+            raise InjectedIOError(point, index)
+        return spec.kind  # nan/inf: the call site corrupts its own data
+
+
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    global _ACTIVE
+    _ACTIVE = injector
+    return injector
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def installed(injector: FaultInjector):
+    install(injector)
+    try:
+        yield injector
+    finally:
+        uninstall()
+
+
+def fire(point: str, index: int) -> Optional[str]:
+    """Hook call sites use. No injector installed: one load + compare."""
+    if _ACTIVE is None:
+        return None
+    return _ACTIVE.fire(point, index)
+
+
+def corrupt_batch(batch, kind: str):
+    """Poison every float leaf of a host batch with NaN/Inf (returns a new
+    pytree; int leaves — token ids, labels — pass through untouched)."""
+    import jax
+
+    bad = np.nan if kind == KIND_NAN else np.inf
+
+    def poison(leaf):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, bad)
+        return leaf
+
+    return jax.tree.map(poison, batch)

@@ -1,0 +1,94 @@
+"""SIGTERM/preemption handling: stop training cleanly, land one last checkpoint.
+
+TPU pods are preemptible; the platform sends SIGTERM with a grace window.
+An installed :class:`PreemptionHandler` turns that signal into a flag the
+Estimator's train loop polls once per step: on the next step boundary the
+loop breaks, the normal final-save path writes a checkpoint, and
+``_ckpt_sync`` drains the :class:`AsyncCheckpointer` — so the resumed job
+restarts from the exact step it was killed at (bitwise, per the
+crash-resume gate in tests/test_resilience.py).
+
+``signal.signal`` only works on the main thread, so ``install()`` must run
+there (the handler chains to any previously-installed handler). The
+module-level :func:`requested` is what the training loop polls — it is a
+cheap list check when no handler is installed.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Dict, List, Sequence
+
+_HANDLERS: List["PreemptionHandler"] = []
+
+
+def requested() -> bool:
+    """True once any installed handler has seen its signal."""
+    return bool(_HANDLERS) and any(h.triggered for h in _HANDLERS)
+
+
+def acknowledge() -> None:
+    """Reset every triggered handler. The train loop calls this the moment
+    it honors a request (it then drains and checkpoints), so a later
+    ``train()`` in a process that survived the signal starts fresh instead
+    of no-opping at its first step forever. A platform that truly wants
+    the process gone re-signals (and ultimately SIGKILLs) anyway."""
+    for handler in _HANDLERS:
+        handler.reset()
+
+
+class PreemptionHandler:
+    """Installable SIGTERM (by default) listener; context-manager friendly.
+
+    ``with PreemptionHandler().install():`` — or call ``install()`` /
+    ``uninstall()`` explicitly. ``trigger()`` sets the flag without a real
+    signal (deterministic tests, cooperative shutdown).
+    """
+
+    def __init__(self, signals: Sequence[int] = (signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self._event = threading.Event()
+        self._previous: Dict[int, object] = {}
+        self._installed = False
+
+    @property
+    def triggered(self) -> bool:
+        return self._event.is_set()
+
+    def trigger(self) -> None:
+        self._event.set()
+
+    def reset(self) -> None:
+        self._event.clear()
+
+    def install(self) -> "PreemptionHandler":
+        if self._installed:
+            return self
+        for sig in self.signals:
+            self._previous[sig] = signal.signal(sig, self._on_signal)
+        self._installed = True
+        _HANDLERS.append(self)
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+        self._installed = False
+        if self in _HANDLERS:
+            _HANDLERS.remove(self)
+
+    def _on_signal(self, signum, frame) -> None:
+        self._event.set()
+        prev = self._previous.get(signum)
+        if callable(prev):
+            prev(signum, frame)  # chain: we observe, we don't swallow
+
+    def __enter__(self) -> "PreemptionHandler":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

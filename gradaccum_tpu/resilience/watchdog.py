@@ -1,0 +1,73 @@
+"""Stall detection for the serving engine's tick loop.
+
+A tick that hangs (deadlocked collective, wedged device, runaway host
+callback) would otherwise leave every client blocked in
+``StreamHandle.result()`` forever — the engine thread is stuck inside the
+dispatch, so no code path ever fails the handles. The :class:`Watchdog` is
+a tiny monitor thread with arm/disarm semantics: the serving loop arms it
+right before each tick dispatch and disarms on return, so idle periods
+(no traffic, nothing armed) can never false-positive. If a single armed
+window exceeds ``timeout`` the ``on_stall`` callback runs ON THE WATCHDOG
+THREAD — it must not block on locks the stalled thread might hold (the
+serving server only flips its error flag and fails handles).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class Watchdog:
+    """Fires ``on_stall(elapsed_seconds)`` once per armed window that
+    exceeds ``timeout``; re-arming starts a fresh window."""
+
+    def __init__(
+        self,
+        timeout: float,
+        on_stall: Callable[[float], None],
+        poll: Optional[float] = None,
+    ):
+        if timeout <= 0:
+            raise ValueError(f"timeout must be > 0, got {timeout}")
+        self.timeout = timeout
+        self._on_stall = on_stall
+        self._poll = poll if poll is not None else max(timeout / 4, 1e-3)
+        self._armed_at: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def arm(self) -> None:
+        self._armed_at = time.monotonic()
+
+    def disarm(self) -> None:
+        self._armed_at = None
+
+    def start(self) -> "Watchdog":
+        if self._thread is not None:
+            raise RuntimeError("watchdog already started")
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serving-watchdog"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            armed_at = self._armed_at
+            if armed_at is None:
+                continue
+            elapsed = time.monotonic() - armed_at
+            if elapsed > self.timeout:
+                self._armed_at = None  # one firing per stalled window
+                try:
+                    self._on_stall(elapsed)
+                except Exception:
+                    pass  # the monitor must survive a failing callback

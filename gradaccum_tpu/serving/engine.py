@@ -40,6 +40,7 @@ from gradaccum_tpu.models.gpt_decode import (
     prefill,
     sample_token,
 )
+from gradaccum_tpu.resilience import faults
 from gradaccum_tpu.serving.cache_pool import CachePool
 from gradaccum_tpu.serving.metrics import ServingMetrics
 from gradaccum_tpu.serving.scheduler import Request, Scheduler
@@ -272,6 +273,10 @@ class Engine:
         if reqs:
             self._admit(reqs, emitted, finished, admitted)
 
+        # seeded crash point between admission and the decode dispatch —
+        # requests in slots at this instant are what recover() hands back
+        faults.fire(faults.MID_DECODE_TICK, t)
+
         active_now = self._active.copy()
         if active_now.any():
             out = self._tick_fn(
@@ -304,6 +309,52 @@ class Engine:
         return (self.results.pop(request_id),
                 self.status.pop(request_id))
 
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a QUEUED request (running ones run to completion). The
+        request's result stays poppable with status "cancelled"; a
+        cancelled request can no longer expire — the scheduler forgot it."""
+        if self.scheduler.cancel(request_id):
+            self.status[request_id] = "cancelled"
+            self.metrics.record_finish(request_id, "cancelled")
+            return True
+        return False
+
+    def recover(self) -> List[Request]:
+        """Reset host-side slot bookkeeping after a failed ``step()``.
+
+        Returns the requests that were RUNNING (their slots are released,
+        status set to "error"; queued requests stay queued — they never
+        touched the device). If the failed dispatch consumed a donated pool
+        buffer (XLA invalidates donated args even on failure), the pool and
+        per-slot arrays are rebuilt — correctness is unaffected because
+        every recovered slot is re-prefilled from scratch on its next
+        admission and slot lengths gate all stale reads. The front-end
+        decides what to do with the returned requests (bounded requeue in
+        :class:`~gradaccum_tpu.serving.server.ServingServer`).
+        """
+        failed = []
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            failed.append(req)
+            self._slot_req[slot] = None
+            self._active[slot] = False
+            self.pool.release(slot)
+            self.status[req.request_id] = "error"
+            # close out the metrics lifecycle too, or the per-request
+            # timing entries leak for every faulted request forever
+            self.metrics.record_finish(req.request_id, "error")
+        device_arrays = (self.pool.k, self.pool.v, self.pool.lengths,
+                         self._cur_tok, self._gen, self._rngs)
+        if any(getattr(a, "is_deleted", lambda: False)() for a in device_arrays):
+            num_slots = self.pool.num_slots
+            self.pool = CachePool(self.cfg, num_slots, self.max_len)
+            key0 = jax.random.PRNGKey(0)
+            self._cur_tok = jnp.zeros((num_slots,), jnp.int32)
+            self._gen = jnp.zeros((num_slots,), jnp.int32)
+            self._rngs = jnp.zeros((num_slots,) + key0.shape, key0.dtype)
+        return failed
+
     def run_until_idle(self, max_ticks: int = 100_000) -> List[StepEvents]:
         events = []
         while not self.idle:
@@ -327,6 +378,13 @@ class Engine:
     def _admit(self, reqs, emitted, finished, admitted) -> None:
         slots = self.pool.claim_many(len(reqs))
         assert len(slots) == len(reqs), "scheduler admitted beyond free slots"
+        # register slot->request BEFORE the prefill dispatch: these requests
+        # are already popped from the scheduler queue, so if the dispatch
+        # raises (OOM, runtime error, injected fault) recover() must be
+        # able to find them — release the slots and hand them back —
+        # instead of leaking the slots and stranding the callers
+        for slot, req in zip(slots, reqs):
+            self._slot_req[slot] = req
         s0 = self._bucket_len(max(r.prompt.size for r in reqs))
         ids = np.zeros((len(reqs), s0), np.int32)
         lens = np.zeros((len(reqs),), np.int32)
@@ -344,7 +402,6 @@ class Engine:
         self.pool.set_arrays(k, v, lengths)
         tok0_host = np.asarray(jax.device_get(tok0))
         for slot, req, tok in zip(slots, reqs, tok0_host):
-            self._slot_req[slot] = req
             self._active[slot] = True
             self.status[req.request_id] = "running"
             admitted.append(req.request_id)

@@ -85,6 +85,16 @@ class Scheduler:
             )
         self._queue.append(request)
 
+    def cancel(self, request_id: int) -> bool:
+        """Remove a QUEUED request (running ones finish on their own; slots
+        are cheap, mid-flight surgery is not). False when not queued — so a
+        later ``expire`` can never double-report a cancelled request."""
+        for r in self._queue:
+            if r.request_id == request_id:
+                self._queue.remove(r)
+                return True
+        return False
+
     def expire(self, tick: int) -> List[Request]:
         """Drop queued requests whose deadline has passed. Returns them."""
         expired = [

@@ -13,6 +13,17 @@ Engine` into a request/response surface:
   time: seeded synthetic arrival traces replayed on the logical tick
   clock, so tests and benchmarks are bit-for-bit reproducible on CPU. The
   engine-parity gate runs here.
+
+Failure contract (resilience layer): an exception out of ``engine.step()``
+never strands a caller. Running requests are recovered via
+``engine.recover()`` and REQUEUED (bounded per-request and by a
+consecutive-fault budget); greedy decoding makes the replayed generation
+token-identical, streaming consumers of a requeued request may observe a
+duplicated prefix. When the budget is exhausted — or the per-tick
+watchdog declares the engine stalled — every pending
+:class:`StreamHandle` fails with the underlying error (``result()``
+raises) and ``stop()`` re-raises it, so an engine death is loud at both
+the per-request and the server lifecycle level.
 """
 
 from __future__ import annotations
@@ -25,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from gradaccum_tpu.resilience.watchdog import Watchdog
 from gradaccum_tpu.serving.engine import Engine
 from gradaccum_tpu.serving.scheduler import QueueFull
 
@@ -37,19 +49,53 @@ class StreamHandle:
 
     def __init__(self, request_id: int):
         self.request_id = request_id
-        self._q: "queue.Queue" = queue.Queue()
+        self._q: "queue.Queue" = queue.Queue()  # (epoch, token) | _DONE
         self._tokens: List[int] = []
         self._reason: Optional[str] = None
+        self._error: Optional[BaseException] = None
         self._closed = threading.Event()
         self._drained = False  # the _DONE sentinel has been consumed
+        # epoch guards _restart against a concurrent consumer: a token
+        # dequeued before the restart carries the old epoch and is
+        # discarded under _mutex, so result() can never glue a pre-fault
+        # token onto the replayed generation
+        self._epoch = 0
+        self._mutex = threading.Lock()
 
     def _put(self, token: int) -> None:
-        self._q.put(token)
+        self._q.put((self._epoch, token))
 
     def _finish(self, reason: str) -> None:
         self._reason = reason
         self._closed.set()
         self._q.put(_DONE)
+
+    def _fail(self, error: BaseException) -> None:
+        """Engine death reaches the caller: ``result()`` raises, iteration
+        ends. This is what replaces the silent forever-hang."""
+        self._error = error
+        self._finish("error")
+
+    def _restart(self) -> None:
+        """Drop buffered output before a requeue re-runs the request from
+        scratch (so ``result()`` returns exactly the final generation)."""
+        with self._mutex:
+            self._epoch += 1
+            self._tokens.clear()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
+    def _consume(self, item) -> Optional[int]:
+        """Append a dequeued item unless a restart obsoleted it."""
+        epoch, token = item
+        with self._mutex:
+            if epoch != self._epoch:
+                return None  # pre-restart stragglers: discard
+            self._tokens.append(token)
+        return token
 
     def __iter__(self):
         while not self._drained:
@@ -57,13 +103,16 @@ class StreamHandle:
             if item is _DONE:
                 self._drained = True
                 return
-            self._tokens.append(item)
-            yield item
+            token = self._consume(item)
+            if token is not None:
+                yield token
 
     def result(self, timeout: Optional[float] = None) -> Tuple[List[int], str]:
         """Drain the stream; returns ``(tokens, finish_reason)``. Raises
         TimeoutError if the request has not finished within ``timeout``
-        seconds (``None`` blocks until it does). Idempotent once finished."""
+        seconds (``None`` blocks until it does), and RuntimeError — chained
+        to the engine's exception — if the request failed. Idempotent once
+        finished."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while not self._drained:
             remaining = (None if deadline is None
@@ -78,25 +127,62 @@ class StreamHandle:
             if item is _DONE:
                 self._drained = True
                 break
-            self._tokens.append(item)
+            self._consume(item)
+        if self._error is not None:
+            raise RuntimeError(
+                f"request {self.request_id} failed: engine error"
+            ) from self._error
         return list(self._tokens), self._reason
 
     @property
     def done(self) -> bool:
         return self._closed.is_set()
 
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
 
 class ServingServer:
-    """Threaded front-end: one engine thread, many submitting threads."""
+    """Threaded front-end: one engine thread, many submitting threads.
 
-    def __init__(self, engine: Engine, idle_sleep: float = 1e-3):
+    ``max_requeues``: how many times one request may be recovered and
+    resubmitted after an engine fault before its handle fails.
+    ``max_engine_faults``: consecutive faulted ticks tolerated before the
+    server gives up entirely (every handle fails, ``stop()`` re-raises).
+    ``watchdog_timeout``: seconds one tick may run before the serving loop
+    is declared stalled — pending handles fail immediately rather than
+    blocking forever on a wedged dispatch.
+    """
+
+    def __init__(
+        self,
+        engine: Engine,
+        idle_sleep: float = 1e-3,
+        max_requeues: int = 1,
+        max_engine_faults: int = 3,
+        watchdog_timeout: Optional[float] = None,
+    ):
         self._engine = engine
         self._idle_sleep = idle_sleep
+        self._max_requeues = max_requeues
+        self._max_engine_faults = max_engine_faults
+        # _lock guards the engine (not thread-safe); _hlock guards the
+        # handle registry + error flag. Separate on purpose: the watchdog's
+        # stall callback must fail handles while the engine thread may be
+        # wedged INSIDE a step() holding _lock.
         self._lock = threading.Lock()
+        self._hlock = threading.Lock()
         self._handles: Dict[int, StreamHandle] = {}
+        self._requeues: Dict[int, int] = {}  # request_id -> times requeued
+        self._faults = 0  # consecutive faulted ticks
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        self._watchdog = (
+            None if watchdog_timeout is None
+            else Watchdog(watchdog_timeout, self._on_stall)
+        )
 
     def start(self) -> "ServingServer":
         if self._thread is not None:
@@ -107,66 +193,226 @@ class ServingServer:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-engine")
         self._thread.start()
+        if self._watchdog is not None:
+            self._watchdog.start()
         return self
 
     def stop(self) -> None:
+        """Stop the loop and close the engine. Re-raises (wrapped) any
+        engine failure the loop died from — an engine death is loud at the
+        lifecycle level, not just per-request. With a watchdog configured,
+        a thread wedged INSIDE a tick is abandoned after a bounded join
+        (it holds ``_lock``, so closing the engine would deadlock) rather
+        than hanging ``stop()`` forever."""
         self._stop.set()
+        wedged = False
         if self._thread is not None:
-            self._thread.join()
+            join_timeout = (None if self._watchdog is None
+                            else max(2 * self._watchdog.timeout, 1.0))
+            self._thread.join(join_timeout)
+            wedged = self._thread.is_alive()
             self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.stop()
         self._abort_handles("aborted")  # in-flight requests must not hang
-        self._engine.close()
+        if wedged:
+            # daemon thread stuck in a dispatch holding _lock: it dies with
+            # the process; touching the engine here would deadlock
+            with self._hlock:
+                if self._error is None:
+                    self._error = TimeoutError(
+                        "engine thread still wedged in a tick at stop()"
+                    )
+        else:
+            with self._lock:
+                self._engine.close()
+        if self._error is not None:
+            raise RuntimeError(
+                "serving engine failed; pending requests were failed"
+            ) from self._error
 
     def __enter__(self) -> "ServingServer":
         return self.start()
 
-    def __exit__(self, *exc) -> None:
-        self.stop()
+    def __exit__(self, exc_type, exc_value, traceback) -> None:
+        try:
+            self.stop()
+        except RuntimeError:
+            # don't mask an exception already propagating out of the body
+            # — the per-handle _fail has made the engine failure loud
+            if exc_type is None:
+                raise
 
     def submit(self, prompt, max_new_tokens: int, **kwargs) -> StreamHandle:
         """Thread-safe; raises :class:`QueueFull` under backpressure and
-        RuntimeError if the engine thread has died."""
-        with self._lock:
-            # checked under the lock: _abort_handles also locks, so a
-            # handle registered here is either serviced or aborted, never
-            # stranded between the error check and registration
+        RuntimeError if the engine has failed or stalled."""
+        with self._hlock:
             if self._error is not None:
                 raise RuntimeError(
                     "serving engine thread died"
                 ) from self._error
+        # submission + registration stay atomic w.r.t. the engine thread:
+        # _lock is held across both, so no tick can retire the request
+        # before its handle exists. Lock order is always _lock -> _hlock.
+        with self._lock:
             rid = self._engine.submit(prompt, max_new_tokens, **kwargs)
             handle = StreamHandle(rid)
-            self._handles[rid] = handle
+            with self._hlock:
+                # re-checked under _hlock: every fail path clears the
+                # registry under this lock, so a handle registered here is
+                # serviced, aborted, or failed — never stranded
+                if self._error is not None:
+                    raise RuntimeError(
+                        "serving engine thread died"
+                    ) from self._error
+                self._handles[rid] = handle
         return handle
 
     def _abort_handles(self, reason: str) -> None:
-        with self._lock:
+        with self._hlock:
             handles = list(self._handles.values())
             self._handles.clear()
+            self._requeues.clear()
         for handle in handles:
             handle._finish(reason)
+
+    def _fail_handles(self, error: BaseException) -> None:
+        with self._hlock:
+            if self._error is None:
+                self._error = error
+            handles = list(self._handles.values())
+            self._handles.clear()
+            self._requeues.clear()
+        for handle in handles:
+            handle._fail(error)
+
+    def _on_stall(self, elapsed: float) -> None:
+        # runs on the watchdog thread; must not touch self._lock (the
+        # stalled engine thread may hold it forever)
+        self._fail_handles(TimeoutError(
+            f"engine tick stalled for {elapsed:.2f}s "
+            f"(watchdog timeout {self._watchdog.timeout}s)"
+        ))
+
+    def _handle_engine_fault(self, exc: BaseException) -> None:
+        """Recover the engine, requeue in-flight requests (bounded), fail
+        the rest. Gives up — fails everything, poisons the server — after
+        ``max_engine_faults`` consecutive faulted ticks."""
+        self._faults += 1
+        give_up = self._faults > self._max_engine_faults
+        with self._hlock:
+            known = list(self._handles)
+        retired = []
+        with self._lock:
+            failed = self._engine.recover()
+            for req in failed:  # server handles own the output now
+                self._engine.results.pop(req.request_id, None)
+                self._engine.status.pop(req.request_id, None)
+            # requests the faulted tick retired BEFORE raising (deadline
+            # expiry, finish-at-admission) lost their StepEvents with the
+            # exception — reconcile them from engine status so their
+            # handles finish instead of hanging
+            for rid in known:
+                if self._engine.status.get(rid) in ("done", "timeout",
+                                                    "cancelled"):
+                    tokens, status = self._engine.pop_result(rid)
+                    retired.append((rid, tokens, status))
+        for rid, tokens, status in retired:
+            with self._hlock:
+                handle = self._handles.pop(rid, None)
+                self._requeues.pop(rid, None)
+            if handle is not None:
+                # engine.results holds the FULL generation; the handle may
+                # already hold earlier-tick tokens (a fault can fire after
+                # the emit loop), so reset before replaying the whole list
+                handle._restart()
+                for token in tokens:
+                    handle._put(token)
+                # "done" here, not eos/length: the retiring event died with
+                # the fault, so the finer-grained reason is gone
+                handle._finish(status)
+        dead: List[StreamHandle] = []
+        plans = []
+        with self._hlock:
+            for req in failed:
+                n = self._requeues.pop(req.request_id, 0)
+                handle = self._handles.pop(req.request_id, None)
+                if handle is None:
+                    continue
+                if give_up or n >= self._max_requeues:
+                    dead.append(handle)
+                else:
+                    plans.append((req, n, handle))
+            if give_up:
+                if self._error is None:
+                    self._error = exc
+                dead.extend(self._handles.values())
+                self._handles.clear()
+                self._requeues.clear()
+                plans = []
+        for req, n, handle in plans:
+            handle._restart()  # the generation re-runs from scratch
+            remaining = (None if req.deadline_tick is None
+                         else max(0, req.deadline_tick - self._engine.tick_count))
+            try:
+                with self._lock:
+                    rid = self._engine.submit(
+                        req.prompt, req.max_new_tokens, eos_id=req.eos_id,
+                        rng_seed=req.rng_seed, deadline_ticks=remaining,
+                    )
+            except Exception as resubmit_exc:  # e.g. QueueFull on a hot queue
+                handle._fail(resubmit_exc)
+                continue
+            with self._hlock:
+                if self._error is not None:
+                    dead.append(handle)
+                    continue
+                handle.request_id = rid
+                self._handles[rid] = handle
+                self._requeues[rid] = n + 1
+        for handle in dead:
+            if handle.error is None:
+                handle._fail(exc)
 
     def _loop(self) -> None:
         try:
             while not self._stop.is_set():
-                with self._lock:
-                    if self._engine.idle:
-                        events = None
-                    else:
-                        events = self._engine.step()
+                with self._hlock:
+                    if self._error is not None:
+                        return  # stall/give-up already failed the handles
+                try:
+                    with self._lock:
+                        if self._engine.idle:
+                            events = None
+                        else:
+                            if self._watchdog is not None:
+                                self._watchdog.arm()
+                            try:
+                                events = self._engine.step()
+                            finally:
+                                if self._watchdog is not None:
+                                    self._watchdog.disarm()
+                except Exception as e:
+                    self._handle_engine_fault(e)
+                    continue
                 if events is None:
                     self._stop.wait(self._idle_sleep)
                     continue
+                self._faults = 0  # a clean tick resets the consecutive budget
                 for rid, tok in events.emitted:
-                    self._handles[rid]._put(tok)
+                    handle = self._handles.get(rid)
+                    if handle is not None:
+                        handle._put(tok)
                 for rid, reason in events.finished:
-                    handle = self._handles.pop(rid, None)
+                    with self._hlock:
+                        handle = self._handles.pop(rid, None)
+                        self._requeues.pop(rid, None)
                     if handle is not None:
                         handle._finish(reason)
-                    self._engine.pop_result(rid)  # handle holds the tokens
-        except BaseException as e:  # a dead tick must not strand callers
-            self._error = e
-            self._abort_handles("aborted")
+                    with self._lock:
+                        self._engine.pop_result(rid)  # handle holds the tokens
+        except BaseException as e:  # a dead dispatch loop must not strand callers
+            self._fail_handles(e)
             raise
 
 

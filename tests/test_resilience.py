@@ -1,0 +1,497 @@
+"""Fault-injection suite: crash-resume exactness, checkpoint integrity,
+non-finite-gradient skip, IO retry, preemption, resource cleanup.
+
+The HEADLINE test kills training at a seeded random step INSIDE an
+accumulation window and asserts the resumed loss/param trajectory is
+bitwise identical to an uninterrupted run — the paper's
+resume-mid-accumulation-cycle guarantee proven under an actual crash, not
+just a polite stop. A second gate corrupts the newest checkpoint and
+requires quarantine + fall-back to the previous one, with the trajectory
+still exact.
+
+Everything here is seeded (failures replay exactly), CPU-only, and fast —
+this file IS part of the tier-1 run (see the ``faults`` marker in
+pyproject.toml).
+"""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gradaccum_tpu.estimator import checkpoint as ckpt_lib
+from gradaccum_tpu.estimator.checkpoint import all_checkpoints
+from gradaccum_tpu.estimator.config import EvalSpec, RunConfig, TrainSpec
+from gradaccum_tpu.estimator.estimator import Estimator, ModelBundle
+from gradaccum_tpu.estimator.metrics import mean_absolute_error
+from gradaccum_tpu.ops import accumulation as acc
+from gradaccum_tpu.ops.adamw import adam, sgd
+from gradaccum_tpu.resilience import faults, manifest, preemption
+from gradaccum_tpu.resilience.faults import (
+    FaultInjector,
+    FaultSchedule,
+    FaultSpec,
+    InjectedCrash,
+)
+from gradaccum_tpu.resilience.retry import retry_io
+
+pytestmark = pytest.mark.faults
+
+K = 4
+
+
+def _bundle():
+    def init(rng, sample):
+        del rng, sample
+        return {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}
+
+    def loss(params, batch):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def predict(params, batch):
+        return {"predictions": batch["x"] @ params["w"] + params["b"]}
+
+    return ModelBundle(
+        init=init, loss=loss, predict=predict,
+        eval_metrics={"mae": mean_absolute_error(label_key="y")},
+    )
+
+
+def _batches(n, seed=0, batch=8):
+    """Deterministic batch stream: position i is identical across calls, so
+    a resumed run can re-enter the stream at any offset."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.normal(size=(batch, 3)).astype(np.float32)
+        y = (x @ np.asarray([[1.0], [-2.0], [0.5]], np.float32)).astype(np.float32)
+        out.append({"x": x, "y": y})
+    return out
+
+
+def _estimator(model_dir, save_every=3, skip=False, async_ckpt=False,
+               first_step_quirk=True):
+    return Estimator(
+        _bundle(),
+        sgd(0.05),
+        acc.GradAccumConfig(num_micro_batches=K, skip_nonfinite=skip,
+                            first_step_quirk=first_step_quirk),
+        RunConfig(model_dir=model_dir, save_checkpoints_steps=save_every,
+                  async_checkpoint=async_ckpt, log_step_count_steps=1000),
+        mode="streaming",
+    )
+
+
+def _assert_states_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        jax.device_get(a), jax.device_get(b),
+    )
+
+
+def _loss_by_step(model_dir):
+    path = os.path.join(model_dir, "loss_vs_step.csv")
+    out = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        next(f)  # header
+        for line in f:
+            step, loss = line.strip().split(",")
+            out[int(step)] = loss  # string compare = bitwise float compare
+    return out
+
+
+# -- the fault harness itself ------------------------------------------------
+
+
+def test_fault_schedule_seeded_replays_exactly():
+    a = FaultSchedule.seeded(1234, n_faults=5, kinds=faults.KINDS)
+    b = FaultSchedule.seeded(1234, n_faults=5, kinds=faults.KINDS)
+    assert [(s.point, s.at, s.kind) for s in a.specs] == \
+           [(s.point, s.at, s.kind) for s in b.specs]
+    c = FaultSchedule.seeded(1235, n_faults=5, kinds=faults.KINDS)
+    assert [(s.point, s.at, s.kind) for s in a.specs] != \
+           [(s.point, s.at, s.kind) for s in c.specs]
+
+
+def test_fault_spec_budget_and_wildcard():
+    sched = FaultSchedule([FaultSpec(faults.MID_DECODE_TICK, at=None,
+                                     kind=faults.KIND_NAN, count=2)])
+    inj = FaultInjector(sched)
+    assert inj.fire(faults.MID_DECODE_TICK, 7) == faults.KIND_NAN
+    assert inj.fire(faults.MID_DECODE_TICK, 9) == faults.KIND_NAN
+    assert inj.fire(faults.MID_DECODE_TICK, 11) is None  # budget spent
+    assert len(inj.fired) == 2
+
+
+# -- HEADLINE: crash mid-accumulation-window, bitwise resume ------------------
+
+
+def test_crash_resume_bitwise_identical_mid_window(tmp_path):
+    """Training killed at a seeded step INSIDE an accumulation window
+    resumes — from a checkpoint that is itself mid-window (save cadence 3,
+    K=4) — to a bitwise-identical loss/param trajectory."""
+    n_steps = 20
+    # seeded crash point, guaranteed mid-window for both the crash and the
+    # preceding checkpoint (save_every=3 vs K=4: ckpt steps 3,6,9 hit
+    # window phases 3,2,1 — never a window boundary)
+    crash_at = int(np.random.default_rng(0xC0FFEE).integers(7, 12))
+    assert crash_at % K != 0
+
+    # uninterrupted reference run
+    est_a = _estimator(str(tmp_path / "a"))
+    state_a = est_a.train(_batches(n_steps), max_steps=n_steps)
+
+    # crashed run: the injected crash escapes train() like a real kill
+    est_b = _estimator(str(tmp_path / "b"))
+    inj = FaultInjector(FaultSchedule(
+        [FaultSpec(faults.POST_TRAIN_STEP, at=crash_at)]
+    ))
+    with faults.installed(inj):
+        with pytest.raises(InjectedCrash):
+            est_b.train(_batches(n_steps), max_steps=n_steps)
+    assert inj.fired == [(faults.POST_TRAIN_STEP, crash_at, faults.KIND_CRASH)]
+
+    # resume in a FRESH estimator (no in-memory state): restores the newest
+    # (mid-window) checkpoint and re-enters the stream at its offset
+    ckpt_step, _ = ckpt_lib.latest_checkpoint(str(tmp_path / "b"))
+    assert 0 < ckpt_step < crash_at and ckpt_step % K != 0
+    est_b2 = _estimator(str(tmp_path / "b"))
+    state_b = est_b2.train(_batches(n_steps)[ckpt_step:], max_steps=n_steps)
+
+    assert int(state_b.step) == n_steps
+    _assert_states_equal(state_a, state_b)  # params, moments, accum, step
+    # loss trajectory after resume is bitwise identical too
+    loss_a, loss_b = _loss_by_step(str(tmp_path / "a")), _loss_by_step(str(tmp_path / "b"))
+    resumed = [s for s in loss_b if s > ckpt_step]
+    assert resumed, "no post-resume losses logged"
+    for s in resumed:
+        assert loss_b[s] == loss_a[s], f"loss diverged at step {s}"
+
+
+def test_corrupt_newest_checkpoint_quarantined_with_exact_fallback(tmp_path):
+    """A truncated newest checkpoint is quarantined; restore falls back to
+    the previous one and the resumed trajectory is STILL bitwise exact."""
+    n_steps = 16
+    est_a = _estimator(str(tmp_path / "a"))
+    state_a = est_a.train(_batches(n_steps), max_steps=n_steps)
+
+    est_b = _estimator(str(tmp_path / "b"))
+    est_b.train(_batches(n_steps), max_steps=10)  # ckpts at 3, 6, 9, 10
+    steps = [s for s, _ in all_checkpoints(str(tmp_path / "b"))]
+    newest, previous = steps[-1], steps[-2]
+    newest_path = dict(
+        (s, p) for s, p in all_checkpoints(str(tmp_path / "b"))
+    )[newest]
+    with open(newest_path, "r+b") as f:
+        f.truncate(12)  # torn write
+
+    est_b2 = _estimator(str(tmp_path / "b"))
+    state_b = est_b2.train(_batches(n_steps)[previous:], max_steps=n_steps)
+
+    assert os.path.exists(newest_path + ".corrupt")  # quarantined, not deleted
+    assert not os.path.exists(newest_path)
+    assert os.path.basename(newest_path) not in manifest.load(str(tmp_path / "b"))
+    assert int(state_b.step) == n_steps
+    _assert_states_equal(state_a, state_b)
+
+
+def test_restore_detects_bitflip_via_manifest(tmp_path):
+    """Same-length corruption (no truncation) is caught by the sha256
+    manifest — msgpack alone could decode it into plausible garbage."""
+    d = str(tmp_path)
+    state = {"w": jnp.arange(8.0)}
+    ckpt_lib.save(d, state, 5)
+    ckpt_lib.save(d, {"w": jnp.arange(8.0) * 2}, 10)
+    path = os.path.join(d, "ckpt-10.msgpack")
+    data = bytearray(open(path, "rb").read())
+    data[-3] ^= 0xFF  # flip bits inside the float payload
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    restored = ckpt_lib.restore(d, jax.device_get(state))
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(8.0))
+    assert os.path.exists(path + ".corrupt")
+
+
+def test_all_checkpoints_corrupt_raises(tmp_path):
+    d = str(tmp_path)
+    state = {"w": jnp.arange(4.0)}
+    for s in (2, 4):
+        p = ckpt_lib.save(d, state, s)
+        with open(p, "r+b") as f:
+            f.truncate(3)
+    with pytest.raises(ckpt_lib.CheckpointCorruptError):
+        ckpt_lib.restore(d, jax.device_get(state))
+
+
+def test_schema_mismatch_never_quarantines_healthy_checkpoints(tmp_path):
+    """A checkpoint whose checksum verifies but which fails to deserialize
+    is a TEMPLATE/schema mismatch (software, not disk): restore must raise
+    loudly and leave every file untouched — renaming healthy checkpoints
+    over a code bug would destroy hours of optimizer state."""
+    d = str(tmp_path)
+    ckpt_lib.save(d, {"w": jnp.arange(4.0)}, 2)
+    ckpt_lib.save(d, {"w": jnp.arange(4.0) * 2}, 4)
+    with pytest.raises(ckpt_lib.CheckpointCorruptError, match="template"):
+        ckpt_lib.restore(d, {"different_field": np.zeros((2,), np.float32)})
+    assert not [n for n in os.listdir(d) if n.endswith(".corrupt")]
+    # the right template still restores everything
+    restored = ckpt_lib.restore(d, jax.device_get({"w": jnp.zeros((4,))}))
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(4.0) * 2)
+
+
+def test_undecodable_without_checksum_skipped_not_renamed(tmp_path):
+    """Pre-manifest files that fail to decode cannot be PROVEN corrupt:
+    restore skips past them to an older checkpoint without renaming."""
+    d = str(tmp_path)
+    ckpt_lib.save(d, {"w": jnp.arange(4.0)}, 2)
+    bad = os.path.join(d, "ckpt-9.msgpack")  # newest, garbage, no manifest entry
+    with open(bad, "wb") as f:
+        f.write(b"not msgpack")
+    restored = ckpt_lib.restore(d, jax.device_get({"w": jnp.zeros((4,))}))
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(4.0))
+    assert os.path.exists(bad) and not os.path.exists(bad + ".corrupt")
+
+
+def test_explicit_checkpoint_path_never_falls_back(tmp_path):
+    d = str(tmp_path)
+    state = {"w": jnp.arange(4.0)}
+    p = ckpt_lib.save(d, state, 2)
+    with open(p, "r+b") as f:
+        f.truncate(3)
+    with pytest.raises(ckpt_lib.CheckpointCorruptError):
+        ckpt_lib.restore(p, jax.device_get(state))
+
+
+def test_stale_tmp_swept_and_io_errors_retried(tmp_path):
+    d = str(tmp_path)
+    state = {"w": jnp.arange(4.0)}
+    # stale tmp from a "crashed writer"
+    with open(os.path.join(d, "ckpt-1.msgpack.tmp"), "wb") as f:
+        f.write(b"dead")
+    # crash mid-write leaves ANOTHER truncated tmp
+    inj = FaultInjector(FaultSchedule(
+        [FaultSpec(faults.MID_CKPT_WRITE, at=2)]
+    ))
+    with faults.installed(inj):
+        with pytest.raises(InjectedCrash):
+            ckpt_lib.save(d, state, 2)
+    assert any(n.endswith(".tmp") for n in os.listdir(d))
+    # two transient IO errors: retried with backoff, save lands anyway —
+    # and the sweep removed every stale tmp first
+    inj = FaultInjector(FaultSchedule(
+        [FaultSpec(faults.MID_CKPT_WRITE, at=4, kind=faults.KIND_IO_ERROR,
+                   count=2)]
+    ))
+    with faults.installed(inj):
+        path = ckpt_lib.save(d, state, 4)
+    assert len(inj.fired) == 2
+    assert not any(n.endswith(".tmp") for n in os.listdir(d))
+    assert manifest.verify(d, path) is True
+
+
+def test_retry_io_exhausts_and_reraises():
+    calls = []
+
+    def always_fails():
+        calls.append(1)
+        raise OSError("disk on fire")
+
+    sleeps = []
+    with pytest.raises(OSError, match="disk on fire"):
+        retry_io(always_fails, attempts=3, base_delay=0.01,
+                 sleep=sleeps.append)
+    assert len(calls) == 3
+    assert sleeps == [0.01, 0.02]  # exponential backoff, no sleep after last
+
+
+# -- non-finite gradients -----------------------------------------------------
+
+
+def test_nan_injection_skips_without_corrupting_window(tmp_path):
+    """A NaN batch inside an accumulation window is skipped (counter
+    surfaced), the window survives, and the final params match a run where
+    that micro-batch contributed exactly zero gradient."""
+    data = _batches(12, seed=3)
+    est = _estimator(str(tmp_path / "f"), save_every=None, skip=True,
+                     first_step_quirk=False)
+    inj = FaultInjector(FaultSchedule(
+        [FaultSpec(faults.PRE_TRAIN_STEP, at=5, kind=faults.KIND_NAN)]
+    ))
+    with faults.installed(inj):
+        state = est.train(data, max_steps=12)
+    assert est.nonfinite_skips == 1
+    for leaf in jax.tree.leaves(jax.device_get(state)):
+        assert np.all(np.isfinite(leaf))
+
+    # ground truth: same stream stepped manually, micro-batch 5's gradient
+    # forced to zero (what "skip without corrupting the window" means)
+    cfg = acc.GradAccumConfig(num_micro_batches=K, first_step_quirk=False)
+    bundle = _bundle()
+    opt = sgd(0.05)
+    step_fn = jax.jit(acc.streaming_step(bundle.loss, opt, cfg))
+    ref = acc.streaming_init(
+        {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}, opt
+    )
+    for i, batch in enumerate(data):
+        if i == 5:
+            batch = {"x": np.zeros_like(batch["x"]),
+                     "y": np.zeros_like(batch["y"])}
+            # zero x AND zero y => pred = b = 0 at that point? No: the
+            # gradient of mean((b - 0)^2) w.r.t. b is 2b != 0 in general,
+            # so instead zero the gradient by skipping the call entirely
+            # and bumping the step like the guarded branch does
+            ref = ref._replace(step=ref.step + 1)
+            continue
+        ref, _ = step_fn(ref, batch)
+    # NOTE: skipping the call entirely matches zero-gradient accumulate
+    # ONLY on non-apply steps; step 5 is mid-window (5 % 4 == 1, quirk-free
+    # apply at 3 mod 4), so this shortcut is exact here.
+    assert 5 % K != K - 1
+    _assert_states_equal(state.params, ref.params)
+
+
+def test_inf_injection_scan_mode(tmp_path):
+    """Scan mode: an Inf batch poisons every micro-batch of its window
+    (host batches are stacked), the whole update is skipped, params carry
+    over bitwise, and the counter reports K skips."""
+    data = _batches(12, seed=4, batch=K * 8)  # scan consumes [K*B] batches
+    est = Estimator(
+        _bundle(), adam(1e-2),
+        acc.GradAccumConfig(num_micro_batches=K, skip_nonfinite=True),
+        RunConfig(model_dir=str(tmp_path), save_checkpoints_steps=None),
+        mode="scan",
+    )
+    inj = FaultInjector(FaultSchedule(
+        [FaultSpec(faults.PRE_TRAIN_STEP, at=2 * K, kind=faults.KIND_INF)]
+    ))
+    with faults.installed(inj):
+        state = est.train(data, max_steps=12 * K)
+    assert est.nonfinite_skips == K
+    for leaf in jax.tree.leaves(jax.device_get(state.params)):
+        assert np.all(np.isfinite(leaf))
+    assert int(state.step) == 12 * K
+
+
+def test_streaming_all_bad_window_skips_apply_entirely():
+    """Streaming mode: when EVERY micro-batch of a window is non-finite,
+    the apply step must leave params AND moments bitwise unchanged (AdamW
+    on a zero average gradient would decay weights and advance moments)."""
+    bundle = _bundle()
+    opt = adam(1e-2)
+    cfg = acc.GradAccumConfig(num_micro_batches=K, first_step_quirk=False,
+                              skip_nonfinite=True)
+    step_fn = jax.jit(acc.streaming_step(bundle.loss, opt, cfg))
+    params0 = {"w": jnp.ones((3, 1)), "b": jnp.ones((1,))}
+    state = acc.streaming_init(params0, opt)
+    bad = {"x": np.full((8, 3), np.nan, np.float32),
+           "y": np.zeros((8, 1), np.float32)}
+    for _ in range(K):  # one full all-bad window, including the apply step
+        state, aux = step_fn(state, bad)
+        assert int(aux["skipped"]) == 1
+    _assert_states_equal(state.params, params0)
+    _assert_states_equal(state.opt_state, acc.streaming_init(params0, opt).opt_state)
+    assert int(state.good_count) == 0  # reset for the next window
+    # and a following good window trains normally
+    good = _batches(K, seed=8)
+    for b in good:
+        state, aux = step_fn(state, b)
+        assert int(aux["skipped"]) == 0
+    assert not np.array_equal(np.asarray(state.params["w"]),
+                              np.asarray(params0["w"]))
+
+
+def test_skip_nonfinite_rejected_on_unsupported_paths():
+    from gradaccum_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(data=2, seq=4)
+    with pytest.raises(ValueError, match="skip_nonfinite"):
+        Estimator(
+            _bundle(), sgd(0.05),
+            acc.GradAccumConfig(num_micro_batches=K, skip_nonfinite=True,
+                                first_step_quirk=False),
+            RunConfig(), mesh=mesh, mode="scan",
+        )
+
+
+# -- preemption + resource lifecycle -----------------------------------------
+
+
+def test_sigterm_drains_async_writer_and_lands_final_checkpoint(tmp_path):
+    est = _estimator(str(tmp_path), save_every=None, async_ckpt=True)
+    handler = preemption.PreemptionHandler().install()
+    try:
+        def stream():
+            for i, b in enumerate(_batches(40, seed=7)):
+                if i == 9:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                yield b
+
+        state = est.train(stream(), max_steps=40)
+        stopped_at = int(state.step)
+        assert 0 < stopped_at < 40  # stopped early, at a step boundary
+        # honoring the request acknowledged it: a surviving process can
+        # train again (handler still installed) instead of no-op looping
+        assert not preemption.requested()
+        state = est.train(_batches(40, seed=7)[stopped_at:], max_steps=40)
+        assert int(state.step) == 40
+    finally:
+        handler.uninstall()
+    # the preemption-step checkpoint landed (async writer drained) and
+    # round-trips
+    steps = [s for s, _ in all_checkpoints(str(tmp_path))]
+    assert stopped_at in steps
+    restored = ckpt_lib.restore(str(tmp_path), jax.device_get(state))
+    _assert_states_equal(state, restored)
+    assert not preemption.requested()  # uninstalled handlers don't linger
+
+
+def test_train_and_evaluate_preemption_saves_final_checkpoint(tmp_path):
+    """Preemption inside a train_and_evaluate chunk (which trains with
+    final_save=False) must still land a checkpoint at the stop step and
+    terminate the schedule — not silently resume the next chunk."""
+    est = _estimator(str(tmp_path), save_every=None, async_ckpt=True)
+    handler = preemption.PreemptionHandler().install()
+    try:
+        data = _batches(200, seed=11)
+
+        def input_fn():
+            def gen():
+                for i, batch in enumerate(data):
+                    if i == 25:
+                        handler.trigger()  # cooperative preemption
+                    yield batch
+            return gen()
+
+        state, results = est.train_and_evaluate(
+            TrainSpec(input_fn, max_steps=200),
+            EvalSpec(lambda: iter(_batches(2, seed=12)), throttle_secs=3600),
+        )
+    finally:
+        handler.uninstall()
+    stopped = int(state.step)
+    assert 0 < stopped < 200  # schedule terminated early
+    assert results is None  # no grace-window eval
+    assert stopped in [s for s, _ in all_checkpoints(str(tmp_path))]
+    assert not preemption.requested()  # acknowledged after the save
+
+
+def test_crash_mid_train_closes_async_writer_and_keeps_checkpoints(tmp_path):
+    est = _estimator(str(tmp_path), save_every=3, async_ckpt=True)
+    inj = FaultInjector(FaultSchedule(
+        [FaultSpec(faults.POST_TRAIN_STEP, at=8)]
+    ))
+    with faults.installed(inj):
+        with pytest.raises(InjectedCrash):
+            est.train(_batches(20), max_steps=20)
+    # close() ran on the exception path: writer drained + shut down
+    assert est._res.async_ckpt is None
+    assert 6 in [s for s, _ in all_checkpoints(str(tmp_path))]
+    # the estimator is still usable: resources recreate lazily
+    state = est.train(_batches(20)[6:], max_steps=20, state=None)
+    assert int(state.step) == 20

@@ -1,0 +1,341 @@
+"""Serving failure contract: engine faults, requeue, watchdog, scheduler edges.
+
+An exception out of ``engine.step()`` must never strand a caller: running
+requests are recovered and requeued (bounded), exhausted budgets fail the
+handles LOUDLY (``result()`` raises, ``stop()`` re-raises), and a stalled
+tick is broken by the watchdog. Greedy decoding makes requeued requests'
+final outputs token-identical to the unfaulted run — the parity gate holds
+THROUGH a fault, not just in fair weather.
+
+Plus the Scheduler edge cases: QueueFull backpressure round-tripped through
+``ServingServer.submit``, deadline expiry exactly at ``tick ==
+deadline_tick`` (not expired — expiry is strictly after), and
+cancel-then-expire never double-reports.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gradaccum_tpu.resilience import faults
+from gradaccum_tpu.resilience.faults import FaultInjector, FaultSchedule, FaultSpec
+
+pytestmark = [pytest.mark.serving, pytest.mark.faults]
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(
+        jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)}
+    )
+    return cfg, bundle, params
+
+
+def _prompts(cfg, n, seed=11, max_len=8):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, cfg.vocab_size,
+                     size=(int(rng.integers(1, max_len)),)).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+# -- engine fault -> recover -> requeue --------------------------------------
+
+
+def test_engine_fault_requeues_and_parity_holds(tiny_lm):
+    """A seeded mid-tick crash: in-flight requests are recovered, requeued,
+    and their final greedy outputs still match solo generate_cached."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    prompts = _prompts(cfg, 4)
+    inj = FaultInjector(FaultSchedule(
+        [FaultSpec(faults.MID_DECODE_TICK, at=2)]
+    ))
+    with faults.installed(inj):
+        server = ServingServer(engine, max_requeues=2).start()
+        handles = [server.submit(p, 6) for p in prompts]
+        results = [h.result(timeout=120) for h in handles]
+        server.stop()  # no give-up: must NOT raise
+    assert inj.fired == [(faults.MID_DECODE_TICK, 2, faults.KIND_CRASH)]
+    for prompt, (tokens, reason) in zip(prompts, results):
+        assert reason in ("eos", "length")
+        want = np.asarray(generate_cached(params, cfg, prompt, 6))
+        np.testing.assert_array_equal(
+            np.asarray(tokens), want[0, prompt.size:]
+        )
+    # recovery left no engine-side bookkeeping behind
+    assert engine.idle
+    assert not engine.results and not engine.status
+
+
+def test_engine_fault_exhausts_budget_fails_loudly(tiny_lm):
+    """Persistent faults: every handle fails with the engine error chained,
+    submit refuses new work, and stop() re-raises."""
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    inj = FaultInjector(FaultSchedule(
+        [FaultSpec(faults.MID_DECODE_TICK, at=None, count=1000)]
+    ))
+    with faults.installed(inj):
+        server = ServingServer(engine, max_requeues=1,
+                               max_engine_faults=2).start()
+        handles = [server.submit(p, 6) for p in _prompts(cfg, 3)]
+        for h in handles:
+            with pytest.raises(RuntimeError) as err:
+                h.result(timeout=60)
+            assert isinstance(err.value.__cause__, faults.InjectedCrash)
+        with pytest.raises(RuntimeError, match="died"):
+            server.submit(_prompts(cfg, 1)[0], 4)
+        with pytest.raises(RuntimeError, match="engine failed"):
+            server.stop()
+
+
+def test_engine_recover_releases_slots_and_rebuilds_pool(tiny_lm):
+    """recover() frees every claimed slot, marks running requests "error",
+    keeps queued ones queued — and the engine still serves exact results
+    afterwards (stale pool contents are overwritten by re-prefill)."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    prompts = _prompts(cfg, 3, seed=5)
+    rids = [engine.submit(p, 5) for p in prompts]
+    engine.step()  # admits 2 (slots), third stays queued
+    assert engine.pool.active_count == 2
+    failed = engine.recover()
+    assert [r.request_id for r in failed] == rids[:2]
+    assert engine.pool.active_count == 0
+    assert engine.scheduler.depth == 1  # queued request untouched
+    assert engine.status[rids[0]] == "error"
+    # the engine keeps working: drain the queued request and a resubmit
+    for rid, prompt in zip(rids[:2], prompts[:2]):
+        engine.results.pop(rid), engine.status.pop(rid)
+    rid2 = engine.submit(prompts[0], 5)
+    engine.run_until_idle()
+    for rid, prompt in ((rids[2], prompts[2]), (rid2, prompts[0])):
+        tokens, status = engine.pop_result(rid)
+        assert status == "done"
+        want = np.asarray(generate_cached(params, cfg, prompt, 5))
+        np.testing.assert_array_equal(np.asarray(tokens), want[0, prompt.size:])
+
+
+def test_fault_after_expiry_still_finishes_expired_handle(tiny_lm):
+    """A request the faulted tick retired BEFORE raising (deadline expiry)
+    loses its finish event with the exception — the server must reconcile
+    it from engine status instead of leaving its handle hanging."""
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=1, max_len=32)
+    prompts = _prompts(cfg, 2, seed=8)
+    # loop NOT started: ticks are driven manually so the expiry and the
+    # crash deterministically land in the same tick
+    server = ServingServer(engine, max_requeues=2)
+    blocker = server.submit(prompts[0], 10)
+    engine.step()  # t=0: admits the blocker into the only slot
+    victim = server.submit(prompts[1], 2, deadline_ticks=0)  # deadline_tick=1
+    engine.step()  # t=1: boundary — not expired yet (strictly after)
+    inj = FaultInjector(FaultSchedule(
+        [FaultSpec(faults.MID_DECODE_TICK, at=2)]
+    ))
+    with faults.installed(inj):
+        with pytest.raises(faults.InjectedCrash) as err:
+            engine.step()  # t=2: expires the victim, THEN the tick dies
+    assert engine.status[victim.request_id] == "timeout"
+    server._handle_engine_fault(err.value)  # what _loop does on a fault
+    tokens, reason = victim.result(timeout=5)
+    assert (tokens, reason) == ([], "timeout")  # finished, not stranded
+    # the running blocker was recovered + requeued, not failed
+    assert blocker.error is None and not blocker.done
+    server.start()  # drain the requeued blocker through the real loop
+    tokens, reason = blocker.result(timeout=120)
+    assert reason in ("eos", "length") and len(tokens) >= 1
+    server.stop()
+
+
+def test_admit_dispatch_failure_recovers_slots_and_requests(tiny_lm):
+    """A prefill dispatch that raises AFTER slots were claimed and requests
+    popped from the queue must still be recoverable: the slot->request
+    mapping is registered before the dispatch, so recover() releases the
+    slots and hands the requests back instead of leaking both."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    original_admit = engine._admit_fn
+    state = {"failed": False}
+
+    def flaky_admit(*args):
+        if not state["failed"]:
+            state["failed"] = True
+            raise RuntimeError("prefill dispatch OOM")
+        return original_admit(*args)
+
+    engine._admit_fn = flaky_admit
+    prompt = _prompts(cfg, 1, seed=12)[0]
+    rid = engine.submit(prompt, 5)
+    with pytest.raises(RuntimeError, match="prefill"):
+        engine.step()
+    failed = engine.recover()
+    assert [r.request_id for r in failed] == [rid]
+    assert engine.pool.active_count == 0  # slots released, not leaked
+    engine.pop_result(rid)  # status "error"
+    # the engine still serves exactly after the fault
+    rid2 = engine.submit(prompt, 5)
+    engine.run_until_idle()
+    tokens, status = engine.pop_result(rid2)
+    assert status == "done"
+    want = np.asarray(generate_cached(params, cfg, prompt, 5))
+    np.testing.assert_array_equal(np.asarray(tokens), want[0, prompt.size:])
+    # metrics lifecycle closed for the failed request: no leaked timers
+    assert not engine.metrics._submit_t and not engine.metrics._last_token_t
+
+
+def test_watchdog_unblocks_stalled_clients(tiny_lm):
+    """A wedged tick must not hang result(): the watchdog fails pending
+    handles with TimeoutError and stop() re-raises."""
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    original_step = engine.step
+
+    def wedged_step():
+        time.sleep(1.0)
+        return original_step()
+
+    engine.step = wedged_step
+    server = ServingServer(engine, watchdog_timeout=0.15).start()
+    handle = server.submit(_prompts(cfg, 1)[0], 4)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError) as err:
+        handle.result(timeout=30)
+    assert isinstance(err.value.__cause__, TimeoutError)
+    assert time.monotonic() - t0 < 1.0  # unblocked BEFORE the tick returned
+    with pytest.raises(RuntimeError, match="engine failed"):
+        server.stop()
+
+
+def test_stream_handle_error_propagation():
+    from gradaccum_tpu.serving import StreamHandle
+
+    handle = StreamHandle(7)
+    handle._put(3)
+    handle._fail(ValueError("boom"))
+    assert handle.done
+    with pytest.raises(RuntimeError, match="request 7 failed") as err:
+        handle.result(timeout=1)
+    assert isinstance(err.value.__cause__, ValueError)
+    assert list(handle) == []  # iteration terminates, no hang
+
+
+# -- scheduler edge cases (satellite) ----------------------------------------
+
+
+def test_queuefull_roundtrip_through_server(tiny_lm):
+    """Backpressure surfaces as QueueFull from ServingServer.submit, and
+    the same request succeeds after the queue drains."""
+    from gradaccum_tpu.serving import Engine, QueueFull, Scheduler, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=1, max_len=32,
+                    scheduler=Scheduler(max_queue=2))
+    server = ServingServer(engine)  # not started: queue can only fill
+    prompts = _prompts(cfg, 3, seed=9)
+    server.submit(prompts[0], 4)
+    server.submit(prompts[1], 4)
+    with pytest.raises(QueueFull):
+        server.submit(prompts[2], 4)
+    # drain, then the rejected request goes through
+    server.start()
+    retry = None
+    deadline = time.monotonic() + 60
+    while retry is None:
+        try:
+            retry = server.submit(prompts[2], 4)
+        except QueueFull:
+            assert time.monotonic() < deadline, "queue never drained"
+            time.sleep(0.01)
+    tokens, reason = retry.result(timeout=60)
+    assert reason in ("eos", "length") and len(tokens) >= 1
+    server.stop()
+
+
+def test_deadline_expiry_exactly_at_boundary():
+    """tick == deadline_tick is still alive; expiry is strictly after."""
+    from gradaccum_tpu.serving.scheduler import Request, Scheduler
+
+    sched = Scheduler()
+    req = Request(request_id=0, prompt=np.array([1], np.int32),
+                  max_new_tokens=1, deadline_tick=5)
+    sched.submit(req)
+    assert sched.expire(5) == []  # boundary: NOT expired
+    assert sched.depth == 1
+    assert [r.request_id for r in sched.expire(6)] == [0]
+    assert sched.depth == 0
+
+
+def test_engine_deadline_boundary(tiny_lm):
+    """Engine-level: a queued request with deadline_ticks=d expires on the
+    first tick AFTER submit_tick + d, never on it."""
+    from gradaccum_tpu.serving import Engine, Scheduler
+
+    cfg, _, params = tiny_lm
+    # max_prefill_per_tick=0 would be invalid; block admission via a full
+    # pool instead: one long-running request holds the single slot
+    engine = Engine(params, cfg, num_slots=1, max_len=32,
+                    scheduler=Scheduler())
+    blocker = engine.submit(_prompts(cfg, 1, seed=3)[0], 20)
+    engine.step()  # admits the blocker
+    rid = engine.submit(_prompts(cfg, 1, seed=4)[0], 2, deadline_ticks=2)
+    deadline_tick = engine.tick_count + 2
+    expired_tick = None
+    while engine.status[rid] == "queued":
+        step_events = engine.step()
+        if (rid, "timeout") in step_events.finished:
+            expired_tick = step_events.tick
+    assert engine.status[rid] == "timeout"
+    assert expired_tick == deadline_tick + 1  # strictly after, never at
+    engine.run_until_idle()
+    engine.pop_result(rid), engine.pop_result(blocker)
+
+
+def test_expire_already_cancelled_request(tiny_lm):
+    """Cancelling a queued request removes it from the queue, so a later
+    expiry sweep can never double-report it; cancel of a running or unknown
+    request returns False."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=1, max_len=32)
+    p = _prompts(cfg, 2, seed=6)
+    blocker = engine.submit(p[0], 8)
+    engine.step()  # blocker takes the only slot
+    rid = engine.submit(p[1], 2, deadline_ticks=1)
+    assert engine.cancel(rid) is True
+    assert engine.status[rid] == "cancelled"
+    assert engine.cancel(rid) is False        # already gone from the queue
+    assert engine.cancel(blocker) is False    # running: not cancellable
+    finished = []
+    for _ in range(4):  # run well past the would-be deadline
+        finished.extend(engine.step().finished)
+    assert all(frid != rid for frid, _ in finished)  # no timeout double-report
+    assert engine.status[rid] == "cancelled"
+    tokens, status = engine.pop_result(rid)
+    assert (tokens, status) == ([], "cancelled")
+    engine.run_until_idle()
+    engine.pop_result(blocker)
