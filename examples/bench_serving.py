@@ -17,7 +17,17 @@ Three legs on the same tiny GPT config:
 Both compiled programs (decode tick, admission prefill) are warmed up
 before any timed window — compile time is a one-off, not a serving cost.
 
-Usage: python examples/bench_serving.py [--out BENCH_serving.json] [--fast]
+``--paged`` runs the paged-KV comparison instead → BENCH_paged.json: a
+fixed-slot pool and a paged pool of EQUAL device memory (same K/V bytes;
+the paged engine spends them on blocks shared by 4× the slots) serve the
+same long-tail workload — many short requests, a few near-max_len ones.
+The fixed pool charges every request ``max_len`` positions, so its
+concurrency is slots; the paged pool charges tokens (rounded to a page),
+so short requests stack. Reported per pool: peak concurrent requests,
+tokens/s, KV bytes per token in flight, block-pool waterline. Acceptance:
+≥2× peak concurrency at equal memory, or ≥30% lower KV bytes per token.
+
+Usage: python examples/bench_serving.py [--out FILE] [--fast] [--paged]
 (``--fast`` shrinks everything for the `slow`-marked CI test.)
 """
 
@@ -142,16 +152,174 @@ def bench_open_loop(cfg, params, prompts, knobs, rate_rps):
     }
 
 
+def _longtail_workload(cfg, fast, rng):
+    """Many short requests, a few near-max ones: the workload where
+    per-token pool accounting pays (most requests waste most of a fixed
+    slot's ``max_len``)."""
+    if fast:
+        shape = dict(max_len=48, short=(8, 8), long=(8, 32),
+                     n_short=6, n_long=2, fixed_slots=2, paged_slots=8,
+                     page_size=8, decode_block=4)
+    else:
+        shape = dict(max_len=96, short=(8, 8), long=(16, 72),
+                     n_short=20, n_long=4, fixed_slots=4, paged_slots=16,
+                     page_size=8, decode_block=8)
+    work = []
+    for kind in ["short"] * shape["n_short"] + ["long"] * shape["n_long"]:
+        plen, new = shape[kind]
+        work.append((
+            rng.integers(0, cfg.vocab_size, plen).astype("int32"), new
+        ))
+    rng.shuffle(work)  # long requests interleaved, not front-loaded
+    return shape, work
+
+
+def _run_closed(eng, work):
+    """Closed load; returns (tokens_per_s, peak_concurrent_requests)."""
+    from gradaccum_tpu.serving import QueueFull
+
+    pending = list(enumerate(work))
+    peak = 0
+    t0 = time.perf_counter()
+    while pending or not eng.idle:
+        still = []
+        for i, (p, n) in pending:
+            try:
+                eng.submit(p, n, rng_seed=i)
+            except QueueFull:
+                still.append((i, (p, n)))
+        pending = still
+        ev = eng.step()
+        # requests co-resident in the pool during THIS tick: the ones
+        # still active plus the ones the tick itself retired (a short
+        # request can be admitted and fully decoded inside one block)
+        peak = max(peak, eng.pool.active_count + len(ev.finished))
+    dt = time.perf_counter() - t0
+    return sum(n for _, n in work) / dt, peak
+
+
+def bench_paged(cfg, params, fast):
+    """Fixed vs paged pools at EQUAL device memory on a long-tail trace."""
+    from gradaccum_tpu.serving import Engine, Scheduler
+
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    shape, work = _longtail_workload(cfg, fast, rng)
+    capacity_tokens = shape["fixed_slots"] * shape["max_len"]
+    num_blocks = capacity_tokens // shape["page_size"]
+
+    def leg(paged):
+        from gradaccum_tpu.serving import ServingMetrics
+
+        kw = dict(page_size=shape["page_size"], num_blocks=num_blocks) \
+            if paged else {}
+        eng = Engine(
+            params, cfg,
+            num_slots=shape["paged_slots" if paged else "fixed_slots"],
+            max_len=shape["max_len"],
+            decode_block=shape["decode_block"],
+            scheduler=Scheduler(max_queue=4 * len(work)),
+            **kw,
+        )
+        _run_closed(eng, work)  # warm pass: compiles tick + admit programs
+        eng.metrics = ServingMetrics()  # timed pass starts clean
+        eng.scheduler.stalls.clear()
+        tps, peak = _run_closed(eng, work)
+        m = eng.metrics.summary()
+        results = {
+            "tokens_per_s": tps,
+            "peak_concurrent_requests": peak,
+            "kv_bytes_per_token_in_flight":
+                m["kv_bytes_per_token_in_flight"],
+            "kv_pool_bytes": (num_blocks * shape["page_size"]
+                              if paged else capacity_tokens)
+                * eng._token_bytes,
+            "token_occupancy_mean": m["token_occupancy"]["mean"],
+            "decode_programs": eng.decode_compile_count(),
+            "num_slots": eng.pool.num_slots,
+        }
+        if paged:
+            results["block_pool_waterline"] = m["block_waterline"]
+            results["num_blocks"] = num_blocks
+            results["admission_stalls"] = dict(eng.scheduler.stalls)
+        return results
+
+    fixed = leg(paged=False)
+    paged = leg(paged=True)
+    concurrency_gain = (paged["peak_concurrent_requests"]
+                        / fixed["peak_concurrent_requests"])
+    kv_ratio = (paged["kv_bytes_per_token_in_flight"]
+                / fixed["kv_bytes_per_token_in_flight"])
+    return {
+        "bench": "paged vs fixed KV pool at equal memory",
+        "workload": {
+            **{k: v for k, v in shape.items()},
+            "n_requests": len(work),
+            "total_new_tokens": sum(n for _, n in work),
+        },
+        "fixed": fixed,
+        "paged": paged,
+        "concurrency_gain": concurrency_gain,
+        "paged_speedup": paged["tokens_per_s"] / fixed["tokens_per_s"],
+        "kv_bytes_per_token_ratio": kv_ratio,
+        "acceptance": {
+            "required": "concurrency_gain >= 2.0 or kv ratio <= 0.7",
+            "passed": concurrency_gain >= 2.0 or kv_ratio <= 0.7,
+        },
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="BENCH_serving.json")
+    ap.add_argument("--out", default=None)
     ap.add_argument("--fast", action="store_true",
                     help="small shapes for the CI slow-lane test")
+    ap.add_argument("--paged", action="store_true",
+                    help="fixed-vs-paged pool comparison -> BENCH_paged.json")
     args = ap.parse_args(argv)
+    if args.out is None:
+        args.out = "BENCH_paged.json" if args.paged else "BENCH_serving.json"
 
     import jax
 
     cfg, params, prompts, knobs = _build(args.fast)
+
+    if args.paged:
+        result = bench_paged(cfg, params, args.fast)
+        result["platform"] = {
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "cpu_count": os.cpu_count(),
+        }
+        result["model"] = {
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+        }
+        print(f"fixed ({result['fixed']['num_slots']} slots): "
+              f"{result['fixed']['tokens_per_s']:.1f} tok/s, "
+              f"peak {result['fixed']['peak_concurrent_requests']} "
+              f"concurrent, "
+              f"{result['fixed']['kv_bytes_per_token_in_flight']:.0f} "
+              "KV B/token", flush=True)
+        print(f"paged ({result['paged']['num_slots']} slots, "
+              f"{result['paged']['num_blocks']} blocks): "
+              f"{result['paged']['tokens_per_s']:.1f} tok/s, "
+              f"peak {result['paged']['peak_concurrent_requests']} "
+              f"concurrent, "
+              f"{result['paged']['kv_bytes_per_token_in_flight']:.0f} "
+              "KV B/token", flush=True)
+        print(f"concurrency gain {result['concurrency_gain']:.2f}x, "
+              f"kv bytes/token ratio {result['kv_bytes_per_token_ratio']:.2f}, "
+              f"speedup {result['paged_speedup']:.2f}x, "
+              f"acceptance passed={result['acceptance']['passed']}")
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.out}")
+        return result
 
     serial_tps = bench_serial(cfg, params, prompts, knobs)
     print(f"serial: {serial_tps:.1f} tok/s", flush=True)

@@ -290,6 +290,140 @@ def decode_step_ragged(params, cfg: GPTConfig, cache: DecodeCache, token,
     return DecodeCache(k=new_k, v=new_v, length=new_len), logits
 
 
+# -- paged KV cache -----------------------------------------------------------
+#
+# The fixed-slot layouts above charge every request ``max_len`` cache
+# positions. The paged variants below page the LENGTH axis into fixed-size
+# blocks of ``page_size`` positions drawn from one global pool
+# ``[num_layers, num_blocks, heads, page_size, head_dim]``; a per-slot PAGE
+# TABLE ``[num_slots, max_pages]`` of int32 block ids translates
+# (slot, position) -> (block, offset) INSIDE the compiled step, vLLM-style.
+# Page tables are plain gather/scatter indices fed as arguments, so every
+# shape stays static and the decode tick still compiles once; pool memory
+# scales with tokens in flight instead of slots × max_len. Unallocated page
+# entries hold the sentinel ``num_blocks``: scatter writes there are DROPPED
+# (XLA out-of-bounds scatter semantics), gather reads clamp to the last
+# block but land at virtual positions beyond the slot's length, which the
+# attention mask removes — so a sentinel can never corrupt or leak state.
+
+
+def init_paged_pool(cfg: GPTConfig, num_blocks: int, page_size: int):
+    """The global block pool: K and V ``[L, num_blocks, H, page_size, hd]``.
+    Block 0..num_blocks-1 are real; index ``num_blocks`` is the dropped-write
+    sentinel used by page tables."""
+    if num_blocks < 1:
+        raise ValueError(f"need at least one block, got {num_blocks}")
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    hd = cfg.hidden_size // cfg.num_heads
+    shape = (cfg.num_layers, num_blocks, cfg.num_heads, page_size, hd)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def decode_step_paged(params, cfg: GPTConfig, pool_k, pool_v, page_table,
+                      lengths, token, active=None, limit=None):
+    """One cached step against the PAGED pool: like
+    :func:`decode_step_ragged` but the cache's length axis lives in pool
+    blocks addressed through ``page_table`` ``[B, max_pages]``.
+
+    ``limit`` ([B] int32, optional) is each slot's write budget: positions
+    at or past it are neither written nor advanced. The serving engine sets
+    it to ``prompt + max_new_tokens`` so block reservations bound the pages
+    a request can ever touch — the tail micro-steps of a decode block that
+    outlive a request's budget (the fixed pool absorbs them in its slack up
+    to ``max_len``) drop their writes instead of demanding pages beyond the
+    reservation. Tokens within the budget are unaffected: the n-th emitted
+    token only needs writes at positions < prompt + n - 1.
+
+    Reads gather each slot's pages into a virtual ``[B, H, max_pages *
+    page_size, hd]`` view (the write for this token lands first, so the
+    newest position is visible to its own query); the per-slot attention
+    mask covers exactly ``[0, length]`` of the virtual axis, so sentinel /
+    stale pages never contribute. Returns ``(pool_k, pool_v, new_lengths,
+    logits)``. Jittable; all shapes static.
+    """
+    b = token.shape[0]
+    num_blocks, page_size = pool_k.shape[1], pool_k.shape[3]
+    max_pages = page_table.shape[1]
+    t_virt = max_pages * page_size
+    pos = lengths  # [B]
+    if active is None:
+        active = jnp.ones((b,), bool)
+    writable = active
+    if limit is not None:
+        writable = writable & (pos < limit)
+    x = _embed(params, cfg, token[:, None], pos[:, None])
+    visible = jnp.arange(t_virt)[None, :] <= pos[:, None]  # [B, T_virt]
+    pos_mask = jnp.where(visible, 0.0, -1e9).astype(cfg.dtype)[:, None, None, :]
+    page = jnp.minimum(pos // page_size, max_pages - 1)[:, None]  # [B, 1]
+    blk = jnp.take_along_axis(page_table, page, axis=1)  # [B, 1]
+    # dropped write for masked rows: out-of-bounds block index
+    blk = jnp.where(writable[:, None], blk, num_blocks)
+    off = (pos % page_size)[:, None]  # [B, 1]
+    hidx = jnp.arange(cfg.num_heads)[None]  # [1, H]
+
+    p = params["params"]
+    new_k, new_v = pool_k, pool_v
+
+    for i in range(cfg.num_layers):
+
+        def attend_cached(q, k, v, i=i):
+            nonlocal new_k, new_v
+            new_k = new_k.at[i, blk, hidx, off].set(
+                k[:, :, 0, :].astype(new_k.dtype)
+            )
+            new_v = new_v.at[i, blk, hidx, off].set(
+                v[:, :, 0, :].astype(new_v.dtype)
+            )
+            # virtual view: [B, MP, H, P, hd] -> [B, H, MP*P, hd]
+            kv_shape = (b, cfg.num_heads, t_virt, k.shape[-1])
+            k_virt = new_k[i][page_table].transpose(0, 2, 1, 3, 4).reshape(kv_shape)
+            v_virt = new_v[i][page_table].transpose(0, 2, 1, 3, 4).reshape(kv_shape)
+            return _attend(q, k_virt, v_virt, pos_mask), None
+
+        x, _ = _block(cfg, p[f"layer_{i}"], x, attend_cached)
+
+    logits = _lm_head(params, cfg, x)[:, 0]
+    new_len = jnp.where(writable, pos + 1, pos)
+    return new_k, new_v, new_len, logits
+
+
+def prefill_paged(params, cfg: GPTConfig, prompt_ids, prompt_lens,
+                  pool_k, pool_v, page_rows):
+    """Ragged batched prefill straight into pool blocks.
+
+    ``prompt_ids`` [B, S0] left-padded, ``prompt_lens`` [B]; ``page_rows``
+    [B, ceil(S0/page_size)] holds each row's allocated block ids for its
+    prompt pages (sentinel ``num_blocks`` for pages past the row's prompt —
+    those page-sized scatter updates are dropped wholesale). Reuses the
+    ragged :func:`prefill` compaction (row b's K/V at positions
+    ``[0, prompt_lens[b])``, zeros after — the zeros land in the last
+    allocated page's tail, where decode writes will overwrite them), then
+    scatters page-size chunks into the pool. Returns ``(pool_k, pool_v,
+    last_logits)``.
+    """
+    b, s0 = prompt_ids.shape
+    page_size = pool_k.shape[3]
+    s0_pages = -(-s0 // page_size)  # static ceil
+    if page_rows.shape != (b, s0_pages):
+        raise ValueError(
+            f"page_rows must be [batch={b}, ceil(S0/page)={s0_pages}], "
+            f"got {page_rows.shape}"
+        )
+    cache, logits = prefill(params, cfg, prompt_ids, s0_pages * page_size,
+                            lengths=prompt_lens)
+    # [L, B, H, s0p*P, hd] -> [L, B, s0p, H, P, hd] page-sized chunks
+    num_layers, _, heads, _, hd = cache.k.shape
+    chunked = (num_layers, b, heads, s0_pages, page_size, hd)
+
+    def to_pages(t):
+        return t.reshape(chunked).transpose(0, 1, 3, 2, 4, 5)
+
+    pool_k = pool_k.at[:, page_rows].set(to_pages(cache.k).astype(pool_k.dtype))
+    pool_v = pool_v.at[:, page_rows].set(to_pages(cache.v).astype(pool_v.dtype))
+    return pool_k, pool_v, logits
+
+
 def _top_k_mask(logits, k: int):
     """Keep the k largest logits (ties at the threshold all survive), mask
     the rest to -inf. ``k`` is static so the program shape never changes."""

@@ -6,13 +6,18 @@ inference. A fixed pool of decode SLOTS (``cache_pool``) is stepped by one
 compiled decode tick (``engine``) that advances every active request at its
 own cache position — admissions batch-prefill into free slots
 (left-padded, masked, via the ragged ``models/gpt_decode.py::prefill``),
-retirements free them, and the tick program never recompiles. Admission
+retirements free them, and the tick program never recompiles. KV memory is
+either slot-granular (``CachePool``: every request holds ``max_len``
+positions) or PAGED (``PagedCachePool`` + ``Engine(page_size=...)``:
+fixed-size blocks handed out as lengths grow, addressed through per-slot
+page tables that are just gather indices — pool memory scales with tokens
+in flight while every shape stays static). Admission
 control with backpressure and deadlines lives in ``scheduler``; a threaded
 front-end plus a deterministic seeded simulation driver in ``server``;
 TTFT / throughput / occupancy telemetry in ``metrics``.
 """
 
-from gradaccum_tpu.serving.cache_pool import CachePool
+from gradaccum_tpu.serving.cache_pool import CachePool, PagedCachePool
 from gradaccum_tpu.serving.engine import Engine, StepEvents
 from gradaccum_tpu.serving.metrics import ServingMetrics
 from gradaccum_tpu.serving.scheduler import QueueFull, Request, Scheduler
@@ -24,6 +29,7 @@ from gradaccum_tpu.serving.server import (
 
 __all__ = [
     "CachePool",
+    "PagedCachePool",
     "Engine",
     "StepEvents",
     "ServingMetrics",

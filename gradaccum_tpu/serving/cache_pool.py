@@ -1,4 +1,4 @@
-"""Fixed-slot KV-cache pool: the engine's only device memory.
+"""KV-cache pools: the engine's only device memory, fixed-slot or paged.
 
 A ``CachePool`` owns one ``[num_layers, num_slots, heads, max_len, head_dim]``
 K/V pair (the :class:`~gradaccum_tpu.models.gpt_decode.DecodeCache` layout
@@ -9,6 +9,26 @@ program keeps the same static shapes, so the decode tick compiles exactly
 once. A released slot needs no device work at all: its stale K/V tail is
 masked by the per-slot length, and the next admission's prefill scatter
 overwrites positions ``[0, len)``.
+
+A ``PagedCachePool`` keeps the same slot bookkeeping but pages the LENGTH
+axis: K/V live in a global block pool ``[num_layers, num_blocks, heads,
+page_size, head_dim]`` and each slot owns a page-table row of block ids, so
+pool memory is charged per TOKEN in flight (rounded up to a page), not per
+slot × max_len. Block accounting is two-level on purpose:
+
+- **reservations** gate admission: a request admitted to a slot reserves
+  its worst case ``ceil((prompt + max_new_tokens) / page_size)`` blocks, so
+  mid-stream allocation can never fail — no preemption/swap machinery, and
+  the engine's write ``limit`` guarantees a slot never touches pages beyond
+  its reservation;
+- **allocations** happen on demand as a slot's length crosses page
+  boundaries, and are what ``kv_bytes_in_use`` reports — an early-EOS
+  request never materializes its unused tail pages.
+
+Releasing a slot reclaims its blocks and reservation; like the fixed pool,
+stale block contents need no device work (attention masks positions past
+each slot's length, and re-allocated pages are overwritten before they
+become visible).
 """
 
 from __future__ import annotations
@@ -16,23 +36,25 @@ from __future__ import annotations
 from typing import List, Optional
 
 import jax.numpy as jnp
+import numpy as np
 
 from gradaccum_tpu.models.gpt import GPTConfig
-from gradaccum_tpu.models.gpt_decode import DecodeCache, init_cache
+from gradaccum_tpu.models.gpt_decode import (
+    DecodeCache,
+    init_cache,
+    init_paged_pool,
+)
 
 
-class CachePool:
-    """Slot bookkeeping (host) + the pooled cache arrays (device)."""
+class _SlotLedger:
+    """Host-side slot claim/release bookkeeping shared by both pools:
+    deterministic lowest-slot-first ordering, claim/release validation,
+    and the static-shape guard on storing device arrays back."""
 
-    def __init__(self, cfg: GPTConfig, num_slots: int, max_len: int):
+    def _init_slots(self, num_slots: int) -> None:
         if num_slots < 1:
             raise ValueError(f"need at least one slot, got {num_slots}")
-        cache = init_cache(cfg, num_slots, max_len)  # validates max_len
-        self.k = cache.k
-        self.v = cache.v
-        self.lengths = jnp.zeros((num_slots,), jnp.int32)
         self.num_slots = num_slots
-        self.max_len = max_len
         self._free: List[int] = list(range(num_slots - 1, -1, -1))
         self._claimed = [False] * num_slots
 
@@ -65,16 +87,12 @@ class CachePool:
             slots.append(slot)
         return slots
 
-    def release(self, slot: int) -> None:
+    def _release_slot(self, slot: int) -> None:
         if not self._claimed[slot]:
             raise ValueError(f"slot {slot} is not claimed")
         self._claimed[slot] = False
         self._free.append(slot)
         self._free.sort(reverse=True)  # deterministic: lowest slot next
-
-    def as_cache(self) -> DecodeCache:
-        """The pool as a DecodeCache (per-slot vector length) for the tick."""
-        return DecodeCache(k=self.k, v=self.v, length=self.lengths)
 
     def set_arrays(self, k, v, lengths) -> None:
         """Store a device program's updated pool (shapes must be unchanged —
@@ -82,3 +100,131 @@ class CachePool:
         if k.shape != self.k.shape or v.shape != self.v.shape:
             raise ValueError("pool shape changed — static shapes are the contract")
         self.k, self.v, self.lengths = k, v, lengths
+
+
+class CachePool(_SlotLedger):
+    """Slot bookkeeping (host) + the pooled cache arrays (device)."""
+
+    def __init__(self, cfg: GPTConfig, num_slots: int, max_len: int):
+        self._init_slots(num_slots)
+        cache = init_cache(cfg, num_slots, max_len)  # validates max_len
+        self.k = cache.k
+        self.v = cache.v
+        self.lengths = jnp.zeros((num_slots,), jnp.int32)
+        self.max_len = max_len
+
+    def release(self, slot: int) -> None:
+        self._release_slot(slot)
+
+    def as_cache(self) -> DecodeCache:
+        """The pool as a DecodeCache (per-slot vector length) for the tick."""
+        return DecodeCache(k=self.k, v=self.v, length=self.lengths)
+
+
+class PagedCachePool(_SlotLedger):
+    """Slot + block bookkeeping (host) and the paged pool arrays (device).
+
+    ``num_blocks`` sets total token capacity (``num_blocks * page_size``
+    positions shared by all slots); ``max_len`` still bounds one REQUEST's
+    cache extent (``max_pages = ceil(max_len / page_size)`` page-table
+    columns). Unassigned page-table entries hold the sentinel
+    ``num_blocks`` (dropped-write semantics in the compiled step).
+    """
+
+    def __init__(self, cfg: GPTConfig, num_slots: int, max_len: int,
+                 page_size: int, num_blocks: int):
+        self._init_slots(num_slots)
+        if max_len % page_size:
+            # keeps a slot's virtual axis exactly max_pages * page_size and
+            # the memory math honest; callers pick page_size | max_len
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of page_size {page_size}"
+            )
+        if max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_len {max_len} exceeds max_position_embeddings "
+                f"{cfg.max_position_embeddings}"
+            )
+        self.k, self.v = init_paged_pool(cfg, num_blocks, page_size)
+        self.lengths = jnp.zeros((num_slots,), jnp.int32)
+        self.max_len = max_len
+        self.page_size = page_size
+        self.num_blocks = num_blocks
+        self.max_pages = max_len // page_size
+        # host-side page-table mirror; uploaded per tick (tiny int32)
+        self.page_table = np.full((num_slots, self.max_pages), num_blocks,
+                                  np.int32)
+        self._free_blocks: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._slot_blocks: List[List[int]] = [[] for _ in range(num_slots)]
+        self._slot_reserved = [0] * num_slots
+        self._reserved_total = 0
+
+    def release(self, slot: int) -> None:
+        """Free the slot AND reclaim its blocks + reservation."""
+        self._release_slot(slot)
+        self._free_blocks.extend(self._slot_blocks[slot])
+        self._free_blocks.sort(reverse=True)  # deterministic: lowest block next
+        self._slot_blocks[slot] = []
+        self.page_table[slot, :] = self.num_blocks
+        self._reserved_total -= self._slot_reserved[slot]
+        self._slot_reserved[slot] = 0
+
+    # -- block accounting -------------------------------------------------
+
+    @property
+    def allocated_blocks(self) -> int:
+        return self.num_blocks - len(self._free_blocks)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free_blocks)
+
+    @property
+    def unreserved_blocks(self) -> int:
+        return self.num_blocks - self._reserved_total
+
+    @property
+    def token_capacity(self) -> int:
+        return self.num_blocks * self.page_size
+
+    def blocks_for(self, tokens: int) -> int:
+        return -(-int(tokens) // self.page_size)
+
+    def can_reserve(self, tokens: int) -> bool:
+        """Would a request needing ``tokens`` cache positions fit? Checked
+        against RESERVATIONS, not current allocation — an admitted request
+        must never hit an empty free list mid-stream."""
+        need = self.blocks_for(tokens)
+        return need <= self.num_blocks - self._reserved_total and \
+            need <= self.max_pages
+
+    def reserve(self, slot: int, tokens: int) -> None:
+        if not self._claimed[slot]:
+            raise ValueError(f"slot {slot} is not claimed")
+        if not self.can_reserve(tokens):
+            raise ValueError(
+                f"cannot reserve {self.blocks_for(tokens)} blocks "
+                f"({self.unreserved_blocks} unreserved of {self.num_blocks})"
+            )
+        self._slot_reserved[slot] = self.blocks_for(tokens)
+        self._reserved_total += self._slot_reserved[slot]
+
+    def alloc_to(self, slot: int, tokens: int) -> None:
+        """Ensure the slot's pages cover ``tokens`` positions (on-demand
+        growth; the engine calls this before each tick with that tick's
+        worst-case end length, clamped to the slot's write limit)."""
+        need = min(self.blocks_for(tokens), self.max_pages)
+        have = len(self._slot_blocks[slot])
+        if need > self._slot_reserved[slot]:
+            raise ValueError(
+                f"slot {slot} needs {need} blocks but reserved only "
+                f"{self._slot_reserved[slot]} — the write limit should have "
+                "made this unreachable"
+            )
+        for page in range(have, need):
+            block = self._free_blocks.pop()  # reservation guarantees supply
+            self._slot_blocks[slot].append(block)
+            self.page_table[slot, page] = block
+
+    def page_table_device(self) -> jnp.ndarray:
+        return jnp.asarray(self.page_table)

@@ -36,14 +36,16 @@ import numpy as np
 from gradaccum_tpu.models.gpt import GPTConfig
 from gradaccum_tpu.models.gpt_decode import (
     DecodeCache,
+    decode_step_paged,
     decode_step_ragged,
     prefill,
+    prefill_paged,
     sample_token,
 )
 from gradaccum_tpu.resilience import faults
-from gradaccum_tpu.serving.cache_pool import CachePool
+from gradaccum_tpu.serving.cache_pool import CachePool, PagedCachePool
 from gradaccum_tpu.serving.metrics import ServingMetrics
-from gradaccum_tpu.serving.scheduler import Request, Scheduler
+from gradaccum_tpu.serving.scheduler import QueueFull, Request, Scheduler
 from gradaccum_tpu.utils.profiling import StepWindowProfiler
 
 
@@ -87,6 +89,37 @@ def _make_tick_fn(cfg: GPTConfig, temperature: float, top_k, block: int):
     return jax.jit(tick, donate_argnums=(1, 2, 3, 4, 5))
 
 
+def _make_paged_tick_fn(cfg: GPTConfig, temperature: float, top_k, block: int):
+    """The paged twin of :func:`_make_tick_fn`: same scan-of-micro-steps,
+    same donation, but K/V reads and writes route through the page table
+    (a non-donated int32 argument — page allocation is host bookkeeping,
+    so the table is data, never a shape) and each slot carries a write
+    ``limit`` so a block's tail micro-steps can't outgrow the slot's
+    reserved pages."""
+
+    def tick(params, k, v, lengths, cur_tok, gen_count, rngs, active,
+             page_table, limit):
+        def pick(lg, key, idx):
+            return sample_token(lg, key, idx, temperature, top_k)
+
+        def body(carry, _):
+            (k, v, lengths), cur, gen = carry
+            k, v, lengths, logits = decode_step_paged(
+                params, cfg, k, v, page_table, lengths, cur, active, limit
+            )
+            nxt = jax.vmap(pick)(logits, rngs, gen).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, cur)
+            gen = gen + active.astype(jnp.int32)
+            return ((k, v, lengths), nxt, gen), nxt
+
+        carry0 = ((k, v, lengths), cur_tok, gen_count)
+        ((k, v, lengths), cur, gen), toks = jax.lax.scan(body, carry0, None,
+                                                         length=block)
+        return k, v, lengths, cur, gen, toks  # toks [block, S]
+
+    return jax.jit(tick, donate_argnums=(1, 2, 3, 4, 5))
+
+
 def _make_admit_fn(cfg: GPTConfig, temperature: float, top_k, max_len: int):
     def admit(params, k, v, lengths, cur_tok, gen_count, rngs,
               ids, prompt_lens, slots, keys):
@@ -107,6 +140,31 @@ def _make_admit_fn(cfg: GPTConfig, temperature: float, top_k, max_len: int):
     return jax.jit(admit, donate_argnums=(1, 2, 3, 4, 5, 6))
 
 
+def _make_paged_admit_fn(cfg: GPTConfig, temperature: float, top_k):
+    """Paged admission: the ragged prefill's compacted K/V scatter straight
+    into the admitted rows' allocated blocks (``page_rows``), per-slot
+    state updated in place. ``limits`` records each request's write budget
+    (prompt + max_new_tokens) for the tick program's clamp."""
+
+    def admit(params, k, v, lengths, cur_tok, gen_count, rngs, limit,
+              ids, prompt_lens, slots, keys, page_rows, limits):
+        k, v, logits = prefill_paged(params, cfg, ids, prompt_lens, k, v,
+                                     page_rows)
+
+        def pick(lg, key):
+            return sample_token(lg, key, 0, temperature, top_k)
+
+        tok0 = jax.vmap(pick)(logits, keys).astype(jnp.int32)
+        lengths = lengths.at[slots].set(prompt_lens)
+        cur_tok = cur_tok.at[slots].set(tok0)
+        gen_count = gen_count.at[slots].set(1)
+        rngs = rngs.at[slots].set(keys)
+        limit = limit.at[slots].set(limits)
+        return k, v, lengths, cur_tok, gen_count, rngs, limit, tok0
+
+    return jax.jit(admit, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
+
+
 class Engine:
     """Multiplexes concurrent generation requests through one decode tick.
 
@@ -119,6 +177,24 @@ class Engine:
     finishing mid-block wastes the block's remaining micro-steps on that
     slot). Not thread-safe: the threaded front-end in server.py serializes
     access.
+
+    ``decode_block_set`` (e.g. ``(1, 4)``) enables DYNAMIC block control:
+    every block size in the set is its own pre-compiled tick program (the
+    compile count stays bounded by the set, asserted in tests) and the host
+    picks one per tick from queue pressure — the smallest block while
+    admissions are waiting (retirements free slots/blocks sooner, better
+    TTFT), the largest once the queue is drained (amortize dispatch).
+    Tokens are identical for every block size, so switching never affects
+    results. The chosen block lands in per-tick metrics.
+
+    ``page_size`` switches the KV pool to PAGED mode: device memory is
+    ``num_blocks`` blocks of ``page_size`` positions shared by all slots
+    (default ``num_slots * max_len / page_size`` blocks — same bytes as
+    the fixed pool; give ``num_blocks`` explicitly to shrink it), each
+    slot maps virtual positions through a page-table row, and admission
+    reserves a request's worst-case pages up front so decoding can never
+    run out mid-stream — the engine refuses admission (and tells you it
+    was BLOCKS, not slots) instead of preempting.
     """
 
     def __init__(
@@ -130,6 +206,9 @@ class Engine:
         temperature: float = 0.0,
         top_k: Optional[int] = None,
         decode_block: int = 1,
+        decode_block_set: Optional[Tuple[int, ...]] = None,
+        page_size: Optional[int] = None,
+        num_blocks: Optional[int] = None,
         scheduler: Optional[Scheduler] = None,
         metrics: Optional[ServingMetrics] = None,
         min_prefill_bucket: int = 8,
@@ -144,12 +223,25 @@ class Engine:
             raise ValueError(f"top_k must be in [1, {cfg.vocab_size}]")
         if decode_block < 1:
             raise ValueError(f"decode_block must be >= 1, got {decode_block}")
+        if num_blocks is not None and page_size is None:
+            raise ValueError("num_blocks needs page_size (paged mode)")
         self.params = params
         self.cfg = cfg
         self.max_len = max_len
         self.temperature = float(temperature)
         self.top_k = None if top_k is None else int(top_k)
-        self.pool = CachePool(cfg, num_slots, max_len)
+        self.paged = page_size is not None
+        self.page_size = None if page_size is None else int(page_size)
+        if self.paged:
+            if num_blocks is None:
+                # equal bytes to the fixed pool by default
+                num_blocks = num_slots * max_len // self.page_size
+            self.num_blocks = int(num_blocks)
+            self.pool = PagedCachePool(cfg, num_slots, max_len,
+                                       self.page_size, self.num_blocks)
+        else:
+            self.num_blocks = None
+            self.pool = CachePool(cfg, num_slots, max_len)
         self.scheduler = scheduler or Scheduler()
         self.metrics = metrics or ServingMetrics()
         self.min_prefill_bucket = min_prefill_bucket
@@ -163,12 +255,36 @@ class Engine:
         self._rngs = jnp.zeros((num_slots,) + key0.shape, key0.dtype)
         self._active = np.zeros((num_slots,), bool)
         self._slot_req: List[Optional[Request]] = [None] * num_slots
+        # paged-only per-slot device/host state: the write budget the tick
+        # clamps against, and a host mirror of each slot's length (exact —
+        # lengths advance by min(block, limit - len) per tick — so the
+        # pre-tick page allocator and token-level gauges never read back)
+        self._limit = jnp.zeros((num_slots,), jnp.int32)
+        self._slot_len = np.zeros((num_slots,), np.int64)
+        self._slot_limit = np.zeros((num_slots,), np.int64)
 
-        self.decode_block = int(decode_block)
-        self._tick_fn = _make_tick_fn(cfg, self.temperature, self.top_k,
-                                      self.decode_block)
-        self._admit_fn = _make_admit_fn(cfg, self.temperature, self.top_k,
-                                        max_len)
+        if decode_block_set is not None:
+            blocks = sorted({int(b) for b in decode_block_set})
+            if not blocks or blocks[0] < 1:
+                raise ValueError(
+                    f"decode_block_set must be >= 1 ints, got {decode_block_set}"
+                )
+            self.decode_block_set = tuple(blocks)
+            self.decode_block = blocks[-1]
+        else:
+            self.decode_block_set = (int(decode_block),)
+            self.decode_block = int(decode_block)
+        make_tick = _make_paged_tick_fn if self.paged else _make_tick_fn
+        self._tick_fns = {
+            b: make_tick(cfg, self.temperature, self.top_k, b)
+            for b in self.decode_block_set
+        }
+        if self.paged:
+            self._admit_fn = _make_paged_admit_fn(cfg, self.temperature,
+                                                  self.top_k)
+        else:
+            self._admit_fn = _make_admit_fn(cfg, self.temperature, self.top_k,
+                                            max_len)
         self._tick = 0
         self._next_id = 0
         # per-request outputs; long-running front-ends MUST evict via
@@ -188,8 +304,10 @@ class Engine:
 
     def decode_compile_count(self) -> int:
         """Distinct decode-tick programs compiled so far. The engine-parity
-        gate asserts this is exactly 1 after any amount of traffic."""
-        return self._tick_fn._cache_size()
+        gate asserts this is exactly 1 after any amount of traffic (one per
+        block size in ``decode_block_set`` when dynamic control is on —
+        bounded by the set, never by traffic)."""
+        return sum(f._cache_size() for f in self._tick_fns.values())
 
     def prefill_compile_count(self) -> int:
         """Distinct (batch, bucketed-length) prefill programs — bounded by
@@ -204,6 +322,9 @@ class Engine:
             "num_slots": self.pool.num_slots,
             "max_len": self.max_len,
             "decode_block": self.decode_block,
+            "decode_block_set": list(self.decode_block_set),
+            "page_size": self.page_size,
+            "num_blocks": self.num_blocks,
             "temperature": self.temperature,
             "top_k": self.top_k,
             "min_prefill_bucket": self.min_prefill_bucket,
@@ -232,6 +353,13 @@ class Engine:
                 f"prompt {prompt.size} + max_new_tokens {max_new_tokens} "
                 f"exceed max_len {self.max_len}"
             )
+        if self.paged:
+            need = self.pool.blocks_for(prompt.size + max_new_tokens)
+            if need > self.pool.num_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool only has "
+                    f"{self.pool.num_blocks} — it could never be admitted"
+                )
         rid = self._next_id
         self._next_id += 1
         req = Request(
@@ -246,6 +374,12 @@ class Engine:
         )
         try:
             self.scheduler.submit(req)
+        except QueueFull as e:
+            self.metrics.record_reject(rid)
+            # backpressure names the scarce resource: operators grow slots
+            # and KV blocks independently, so "which one ran out" is the
+            # whole diagnosis
+            raise QueueFull(f"{e}; bottleneck: {self._bottleneck()}") from None
         except Exception:
             self.metrics.record_reject(rid)
             raise
@@ -255,6 +389,37 @@ class Engine:
         return rid
 
     # -- the tick ---------------------------------------------------------
+
+    def _pick_block(self) -> int:
+        """Dynamic decode-block policy (host-side, among pre-compiled
+        programs only): smallest block while requests wait on admission —
+        retirements free slots/blocks at block granularity, so small blocks
+        cut queued TTFT — largest block otherwise, to amortize dispatch."""
+        if len(self.decode_block_set) == 1:
+            return self.decode_block_set[0]
+        if self.scheduler.depth > 0:
+            return self.decode_block_set[0]
+        return self.decode_block_set[-1]
+
+    def _bottleneck(self) -> str:
+        """Which pool resource is exhausted right now (backpressure detail)."""
+        if self.pool.free_count == 0:
+            return "no free slots"
+        if self.paged:
+            # judge by what admission would actually ask for: the queue
+            # head's reservation (one page when the queue is empty)
+            head = self.scheduler.peek()
+            need = (self.pool.blocks_for(head.prompt.size + head.max_new_tokens)
+                    if head is not None else 1)
+            if need > self.pool.unreserved_blocks:
+                return "no free KV blocks"
+        return "queue backlog (slots available)"
+
+    @property
+    def _token_bytes(self) -> int:
+        """Pool bytes per cache position (K and V, all layers)."""
+        return 2 * self.cfg.num_layers * self.cfg.hidden_size * \
+            jnp.dtype(self.cfg.dtype).itemsize
 
     def step(self) -> StepEvents:
         """One engine tick: expire → admit/prefill → fused decode."""
@@ -269,23 +434,62 @@ class Engine:
             finished.append((req.request_id, "timeout"))
             self.metrics.record_finish(req.request_id, "timeout")
 
-        reqs = self.scheduler.admit(self.pool.free_count, t)
+        fits = None
+        if self.paged:
+            # the gate must count reservations from EARLIER requests in
+            # this same admission batch (they only land in the pool inside
+            # _admit, after the scheduler pops)
+            pending = [0]
+
+            def fits(r):
+                need = self.pool.blocks_for(r.prompt.size + r.max_new_tokens)
+                if (pending[0] + need > self.pool.unreserved_blocks
+                        or need > self.pool.max_pages):
+                    return False
+                pending[0] += need
+                return True
+
+        reqs = self.scheduler.admit(self.pool.free_count, t, fits=fits)
         if reqs:
             self._admit(reqs, emitted, finished, admitted)
+        if self.scheduler.depth > 0 and self.pool.free_count == 0:
+            self.scheduler.record_stall("no_free_slots")
 
         # seeded crash point between admission and the decode dispatch —
         # requests in slots at this instant are what recover() hands back
         faults.fire(faults.MID_DECODE_TICK, t)
 
+        block = self._pick_block()
         active_now = self._active.copy()
         if active_now.any():
-            out = self._tick_fn(
+            args = (
                 self.params, self.pool.k, self.pool.v, self.pool.lengths,
                 self._cur_tok, self._gen, self._rngs, jnp.asarray(active_now),
             )
+            if self.paged:
+                # grow page tables BEFORE the dispatch to this tick's
+                # worst-case end position (never past the write limit, so
+                # the admission-time reservation always covers it)
+                for slot in np.nonzero(active_now)[0]:
+                    self.pool.alloc_to(
+                        int(slot),
+                        min(self._slot_len[slot] + block,
+                            self._slot_limit[slot]),
+                    )
+                out = self._tick_fns[block](
+                    *args, self.pool.page_table_device(), self._limit
+                )
+            else:
+                out = self._tick_fns[block](*args)
             k, v, lengths, nxt, gen, toks = out
             self.pool.set_arrays(k, v, lengths)
             self._cur_tok, self._gen = nxt, gen
+            # host length mirror: paged writes clamp at the slot limit,
+            # fixed ones at max_len (out-of-bounds scatter drop)
+            self._slot_len[active_now] = np.minimum(
+                self._slot_len[active_now] + block,
+                self._slot_limit[active_now] if self.paged else self.max_len,
+            )
             toks_host = np.asarray(jax.device_get(toks))  # [block, slots]
             for d in range(toks_host.shape[0]):
                 for slot in np.nonzero(active_now)[0]:
@@ -295,9 +499,26 @@ class Engine:
                     self._emit(int(slot), req, int(toks_host[d, slot]),
                                emitted, finished, first=False)
 
-        self.metrics.record_tick(
-            self.scheduler.depth, self.pool.active_count, self.pool.num_slots
+        gauges = dict(
+            tokens_in_flight=int(self._slot_len[self._active].sum()),
+            decode_block=block,
         )
+        if self.paged:
+            gauges.update(
+                token_capacity=self.pool.token_capacity,
+                kv_bytes_in_use=(self.pool.allocated_blocks * self.page_size
+                                 * self._token_bytes),
+                free_blocks=self.pool.free_blocks,
+            )
+        else:
+            gauges.update(
+                token_capacity=self.pool.num_slots * self.max_len,
+                # the fixed pool charges every active slot its full extent
+                kv_bytes_in_use=(self.pool.active_count * self.max_len
+                                 * self._token_bytes),
+            )
+        self.metrics.record_tick(self.scheduler.depth, self.pool.active_count,
+                                 self.pool.num_slots, **gauges)
         self._tick = t + 1
         return StepEvents(emitted, finished, admitted, t)
 
@@ -345,14 +566,21 @@ class Engine:
             # timing entries leak for every faulted request forever
             self.metrics.record_finish(req.request_id, "error")
         device_arrays = (self.pool.k, self.pool.v, self.pool.lengths,
-                         self._cur_tok, self._gen, self._rngs)
+                         self._cur_tok, self._gen, self._rngs, self._limit)
         if any(getattr(a, "is_deleted", lambda: False)() for a in device_arrays):
             num_slots = self.pool.num_slots
-            self.pool = CachePool(self.cfg, num_slots, self.max_len)
+            if self.paged:
+                self.pool = PagedCachePool(self.cfg, num_slots, self.max_len,
+                                           self.page_size, self.num_blocks)
+            else:
+                self.pool = CachePool(self.cfg, num_slots, self.max_len)
             key0 = jax.random.PRNGKey(0)
             self._cur_tok = jnp.zeros((num_slots,), jnp.int32)
             self._gen = jnp.zeros((num_slots,), jnp.int32)
             self._rngs = jnp.zeros((num_slots,) + key0.shape, key0.dtype)
+            self._limit = jnp.zeros((num_slots,), jnp.int32)
+            self._slot_len[:] = 0
+            self._slot_limit[:] = 0
         return failed
 
     def run_until_idle(self, max_ticks: int = 100_000) -> List[StepEvents]:
@@ -392,13 +620,42 @@ class Engine:
             ids[i, s0 - r.prompt.size:] = r.prompt
             lens[i] = r.prompt.size
         keys = jnp.stack([jax.random.PRNGKey(r.rng_seed) for r in reqs])
-        out = self._admit_fn(
-            self.params, self.pool.k, self.pool.v, self.pool.lengths,
-            self._cur_tok, self._gen, self._rngs,
-            jnp.asarray(ids), jnp.asarray(lens),
-            jnp.asarray(slots, jnp.int32), keys,
-        )
-        k, v, lengths, self._cur_tok, self._gen, self._rngs, tok0 = out
+        if self.paged:
+            # reserve the worst case, allocate the prompt's pages now —
+            # decode pages arrive on demand as lengths cross boundaries
+            page_size = self.page_size
+            s0_pages = -(-s0 // page_size)
+            page_rows = np.full((len(reqs), s0_pages), self.pool.num_blocks,
+                                np.int32)
+            limits = np.zeros((len(reqs),), np.int32)
+            for i, (slot, r) in enumerate(zip(slots, reqs)):
+                budget = r.prompt.size + r.max_new_tokens
+                self.pool.reserve(slot, budget)
+                self.pool.alloc_to(slot, r.prompt.size)
+                n = self.pool.blocks_for(r.prompt.size)
+                page_rows[i, :n] = self.pool.page_table[slot, :n]
+                limits[i] = budget
+                self._slot_len[slot] = r.prompt.size
+                self._slot_limit[slot] = budget
+            out = self._admit_fn(
+                self.params, self.pool.k, self.pool.v, self.pool.lengths,
+                self._cur_tok, self._gen, self._rngs, self._limit,
+                jnp.asarray(ids), jnp.asarray(lens),
+                jnp.asarray(slots, jnp.int32), keys,
+                jnp.asarray(page_rows), jnp.asarray(limits),
+            )
+            (k, v, lengths, self._cur_tok, self._gen, self._rngs,
+             self._limit, tok0) = out
+        else:
+            for slot, r in zip(slots, reqs):
+                self._slot_len[slot] = r.prompt.size
+            out = self._admit_fn(
+                self.params, self.pool.k, self.pool.v, self.pool.lengths,
+                self._cur_tok, self._gen, self._rngs,
+                jnp.asarray(ids), jnp.asarray(lens),
+                jnp.asarray(slots, jnp.int32), keys,
+            )
+            k, v, lengths, self._cur_tok, self._gen, self._rngs, tok0 = out
         self.pool.set_arrays(k, v, lengths)
         tok0_host = np.asarray(jax.device_get(tok0))
         for slot, req, tok in zip(slots, reqs, tok0_host):

@@ -35,7 +35,17 @@ class ServingMetrics:
         self.ttft = LatencySeries()          # submit -> first token
         self.token_latency = LatencySeries()  # inter-token gap, per request
         self.queue_depth = LatencySeries()    # sampled per tick
-        self.occupancy = LatencySeries()      # sampled per tick
+        self.occupancy = LatencySeries()      # sampled per tick (slots)
+        # token-level view, present for BOTH pool kinds so fixed and paged
+        # runs land on one dashboard: tokens in flight / pool token
+        # capacity, and the bytes the pool actually charges for them (the
+        # fixed pool charges a full slot; paged charges allocated pages)
+        self.token_occupancy = LatencySeries()   # sampled per tick
+        self.kv_bytes_in_use = LatencySeries()   # sampled per tick
+        self.tokens_in_flight = LatencySeries()  # sampled per tick
+        self._kv_per_token = LatencySeries()     # bytes/token, loaded ticks
+        self.block_waterline: Optional[int] = None  # min free blocks seen
+        self.decode_block_ticks: Dict[int, int] = {}  # chosen block -> ticks
         self._submit_t: Dict[int, float] = {}
         self._last_token_t: Dict[int, float] = {}
         self.tokens_emitted = 0
@@ -72,20 +82,44 @@ class ServingMetrics:
     # -- per-tick gauges --------------------------------------------------
 
     def record_tick(self, queue_depth: int, active_slots: int,
-                    num_slots: int) -> None:
+                    num_slots: int, *,
+                    tokens_in_flight: Optional[int] = None,
+                    token_capacity: Optional[int] = None,
+                    kv_bytes_in_use: Optional[int] = None,
+                    free_blocks: Optional[int] = None,
+                    decode_block: Optional[int] = None) -> None:
         self.ticks += 1
         self.queue_depth.add(queue_depth)
         self.occupancy.add(active_slots / num_slots)
-        if self._writer is not None and self._writer.active:
-            self._writer.scalars(
-                {
-                    "serving/queue_depth": float(queue_depth),
-                    "serving/active_slots": float(active_slots),
-                    "serving/tokens_emitted": float(self.tokens_emitted),
-                },
-                step=self.ticks,
-                subdir=self._subdir,
+        scalars = {
+            "serving/queue_depth": float(queue_depth),
+            "serving/active_slots": float(active_slots),
+            "serving/tokens_emitted": float(self.tokens_emitted),
+        }
+        if tokens_in_flight is not None:
+            self.tokens_in_flight.add(tokens_in_flight)
+            scalars["serving/tokens_in_flight"] = float(tokens_in_flight)
+            if token_capacity:
+                self.token_occupancy.add(tokens_in_flight / token_capacity)
+                scalars["serving/token_occupancy"] = (
+                    tokens_in_flight / token_capacity
+                )
+        if kv_bytes_in_use is not None:
+            self.kv_bytes_in_use.add(kv_bytes_in_use)
+            scalars["serving/kv_bytes_in_use"] = float(kv_bytes_in_use)
+            if tokens_in_flight:
+                self._kv_per_token.add(kv_bytes_in_use / tokens_in_flight)
+        if free_blocks is not None:
+            if self.block_waterline is None or free_blocks < self.block_waterline:
+                self.block_waterline = free_blocks
+            scalars["serving/free_kv_blocks"] = float(free_blocks)
+        if decode_block is not None:
+            self.decode_block_ticks[decode_block] = (
+                self.decode_block_ticks.get(decode_block, 0) + 1
             )
+            scalars["serving/decode_block"] = float(decode_block)
+        if self._writer is not None and self._writer.active:
+            self._writer.scalars(scalars, step=self.ticks, subdir=self._subdir)
 
     # -- summary ----------------------------------------------------------
 
@@ -95,12 +129,24 @@ class ServingMetrics:
         dt = self.clock() - self._t0
         return self.tokens_emitted / dt if dt > 0 else None
 
+    def kv_bytes_per_token_in_flight(self) -> Optional[float]:
+        """Mean pool bytes charged per token in flight (over ticks with
+        traffic) — THE fixed-vs-paged comparison number (the paged pool's
+        reason to exist)."""
+        return self._kv_per_token.summary()["mean"]
+
     def summary(self) -> dict:
         return {
             "ttft": self.ttft.summary(),
             "token_latency": self.token_latency.summary(),
             "queue_depth": self.queue_depth.summary(),
             "occupancy": self.occupancy.summary(),
+            "token_occupancy": self.token_occupancy.summary(),
+            "tokens_in_flight": self.tokens_in_flight.summary(),
+            "kv_bytes_in_use": self.kv_bytes_in_use.summary(),
+            "kv_bytes_per_token_in_flight": self.kv_bytes_per_token_in_flight(),
+            "block_waterline": self.block_waterline,
+            "decode_block_ticks": dict(self.decode_block_ticks),
             "tokens_emitted": self.tokens_emitted,
             "tokens_per_second": self.tokens_per_second(),
             "ticks": self.ticks,

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 from collections import deque
-from typing import Deque, List, Optional
+from typing import Callable, Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -72,10 +72,20 @@ class Scheduler:
         self.max_prefill_per_tick = max_prefill_per_tick
         self.prefill_interval = prefill_interval
         self._queue: Deque[Request] = deque()
+        # why admission stalled, per tick it stalled: "no_free_slots" vs
+        # "no_free_blocks" tells an operator which resource to grow
+        self.stalls: Dict[str, int] = {}
+
+    def record_stall(self, reason: str) -> None:
+        self.stalls[reason] = self.stalls.get(reason, 0) + 1
 
     @property
     def depth(self) -> int:
         return len(self._queue)
+
+    def peek(self) -> Optional[Request]:
+        """The request next in line for admission (None when empty)."""
+        return self._queue[0] if self._queue else None
 
     def submit(self, request: Request) -> None:
         if len(self._queue) >= self.max_queue:
@@ -106,8 +116,16 @@ class Scheduler:
             self._queue = deque(r for r in self._queue if id(r) not in dead)
         return expired
 
-    def admit(self, free_slots: int, tick: int) -> List[Request]:
-        """FIFO-pop up to ``free_slots`` requests (policy permitting)."""
+    def admit(self, free_slots: int, tick: int,
+              fits: Optional[Callable[[Request], bool]] = None
+              ) -> List[Request]:
+        """FIFO-pop up to ``free_slots`` requests (policy permitting).
+
+        ``fits`` (optional) is a per-request resource gate — the paged
+        engine passes a block-reservation check. Admission stops at the
+        FIRST request that doesn't fit (strict FIFO: no reordering around
+        a starved head) and records a ``no_free_blocks`` stall.
+        """
         if free_slots <= 0 or not self._queue:
             return []
         if tick % self.prefill_interval != 0:
@@ -117,5 +135,8 @@ class Scheduler:
             n = min(n, self.max_prefill_per_tick)
         admitted = []
         while self._queue and len(admitted) < n:
+            if fits is not None and not fits(self._queue[0]):
+                self.record_stall("no_free_blocks")
+                break
             admitted.append(self._queue.popleft())
         return admitted

@@ -243,6 +243,26 @@ class ServingServer:
             if exc_type is None:
                 raise
 
+    def stats(self) -> Dict:
+        """Thread-safe operator snapshot: the metrics summary plus live
+        pool state — slot AND token/block occupancy (the paged pool's
+        admission currency) and why admission last stalled."""
+        with self._lock:
+            engine = self._engine
+            pool = engine.pool
+            out = {
+                "metrics": engine.metrics.summary(),
+                "queue_depth": engine.scheduler.depth,
+                "admission_stalls": dict(engine.scheduler.stalls),
+                "active_slots": pool.active_count,
+                "num_slots": pool.num_slots,
+            }
+            if engine.paged:
+                out["free_kv_blocks"] = pool.free_blocks
+                out["num_kv_blocks"] = pool.num_blocks
+                out["kv_token_capacity"] = pool.token_capacity
+        return out
+
     def submit(self, prompt, max_new_tokens: int, **kwargs) -> StreamHandle:
         """Thread-safe; raises :class:`QueueFull` under backpressure and
         RuntimeError if the engine has failed or stalled."""

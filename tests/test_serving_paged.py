@@ -1,0 +1,402 @@
+"""Paged KV cache: parity, block accounting, compile bounds, backpressure.
+
+The load-bearing gate mirrors the fixed-slot engine's: under seeded arrival
+traces the PAGED engine's output — greedy and sampled, including requests
+that retire mid-stream via EOS so their blocks are reclaimed and reused —
+must be token-for-token what ``generate_cached`` produces for each prompt
+alone, with the decode-program count bounded by the pre-compiled
+``decode_block_set`` (paging is gather indices, never shapes).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+pytestmark = [pytest.mark.serving, pytest.mark.paged]
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(
+        jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)}
+    )
+    return cfg, bundle, params
+
+
+# -- the paged parity gate ----------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_paged_engine_greedy_parity_and_compile_once(tiny_lm, seed):
+    """Seeded traces through the paged pool (page_size 4, equal-memory
+    default block count): streamed greedy outputs == solo generate_cached,
+    ONE decode program, and every block reclaimed at idle."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=4, max_len=32, page_size=4)
+    driver = SimulationDriver(engine, seed=seed)
+    trace = driver.make_trace(9, arrival_rate=0.6, prompt_len=(1, 12),
+                              max_new=(1, 12))
+    records = driver.run(trace)
+
+    assert len(records) == len(trace)
+    for item, rec in zip(trace, records):
+        assert rec["status"] == "done"
+        want = generate_cached(params, cfg, item.prompt, item.max_new_tokens)
+        want_new = np.asarray(want)[0, item.prompt.size:]
+        np.testing.assert_array_equal(np.asarray(rec["tokens"]), want_new)
+
+    assert engine.decode_compile_count() == 1
+    assert engine.idle
+    # retirement reclaimed every block and reservation
+    assert engine.pool.allocated_blocks == 0
+    assert engine.pool.unreserved_blocks == engine.pool.num_blocks
+
+
+def test_paged_vs_fixed_token_for_token(tiny_lm):
+    """The direct tentpole gate: the SAME trace through a fixed-slot and a
+    paged engine yields identical per-request token streams (greedy), so
+    paging is invisible to results."""
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+
+    def run(**kw):
+        engine = Engine(params, cfg, num_slots=4, max_len=32, **kw)
+        driver = SimulationDriver(engine, seed=5)
+        trace = driver.make_trace(10, arrival_rate=0.7, prompt_len=(1, 12),
+                                  max_new=(1, 12))
+        return [rec["tokens"] for rec in driver.run(trace)]
+
+    fixed = run()
+    paged = run(page_size=8)
+    assert fixed == paged
+
+
+def test_paged_sampled_parity(tiny_lm):
+    """Per-request rng streams survive the page-table indirection."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=3, max_len=32, page_size=4,
+                    temperature=0.8, top_k=5)
+    driver = SimulationDriver(engine, seed=11)
+    trace = driver.make_trace(6, arrival_rate=0.8, prompt_len=(2, 10),
+                              max_new=(3, 10))
+    records = driver.run(trace)
+    for item, rec in zip(trace, records):
+        want = generate_cached(
+            params, cfg, item.prompt, item.max_new_tokens,
+            temperature=0.8, top_k=5, rng=jax.random.PRNGKey(item.rng_seed),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(rec["tokens"]),
+            np.asarray(want)[0, item.prompt.size:],
+        )
+
+
+def test_paged_eos_reclaims_blocks_and_reuses_them(tiny_lm):
+    """A request stopping early at eos_id releases its blocks mid-stream;
+    a queued request is then admitted into RECYCLED pages and still decodes
+    exactly (stale block contents must be invisible)."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, 6).astype(np.int32)
+    full = np.asarray(generate_cached(params, cfg, prompt, 8))[0, 6:]
+    k = next(i for i in range(1, len(full)) if full[i] not in full[:i])
+    eos = int(full[k])
+
+    # one slot's worth of blocks: the second request NEEDS the first one's
+    # reclaimed pages (14 tokens budget -> 4 pages of 4; pool holds 4)
+    engine = Engine(params, cfg, num_slots=2, max_len=16, page_size=4,
+                    num_blocks=4)
+    rid = engine.submit(prompt, 8, eos_id=eos)
+    rid2 = engine.submit(prompt, 4)  # blocked on blocks, not slots
+    engine.run_until_idle()
+    assert engine.results[rid] == list(full[:k + 1])
+    assert engine.status[rid] == "done"
+    assert engine.results[rid2] == list(full[:4])
+    assert engine.scheduler.stalls.get("no_free_blocks", 0) > 0
+    assert engine.pool.allocated_blocks == 0
+
+
+def test_paged_dynamic_decode_block(tiny_lm):
+    """decode_block_set: parity holds across host-side block switching,
+    decode programs are bounded by the SET (not 1), and the per-tick
+    metrics record which block ran."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                    decode_block_set=(1, 4))
+    driver = SimulationDriver(engine, seed=3)
+    # 2 slots + bursty arrivals -> ticks with a backlog (block 1) AND
+    # drained ticks (block 4), so the policy exercises both programs
+    trace = driver.make_trace(8, arrival_rate=0.9, prompt_len=(1, 10),
+                              max_new=(4, 12))
+    records = driver.run(trace)
+    for item, rec in zip(trace, records):
+        want = generate_cached(params, cfg, item.prompt, item.max_new_tokens)
+        np.testing.assert_array_equal(
+            np.asarray(rec["tokens"]),
+            np.asarray(want)[0, item.prompt.size:],
+        )
+    chosen = engine.metrics.summary()["decode_block_ticks"]
+    assert set(chosen) == {1, 4}, chosen
+    assert engine.decode_compile_count() <= len(engine.decode_block_set)
+    assert engine.decode_compile_count() == 2  # both actually ran
+
+
+# -- pool bookkeeping ---------------------------------------------------------
+
+
+def test_paged_pool_accounting():
+    from gradaccum_tpu.models.gpt import GPTConfig
+    from gradaccum_tpu.serving import PagedCachePool
+
+    cfg = GPTConfig.tiny_for_tests()
+    pool = PagedCachePool(cfg, num_slots=2, max_len=16, page_size=4,
+                          num_blocks=6)
+    assert pool.token_capacity == 24
+    a = pool.claim()
+    pool.reserve(a, 10)  # 3 pages
+    assert pool.unreserved_blocks == 3
+    pool.alloc_to(a, 5)  # 2 pages materialize
+    assert pool.allocated_blocks == 2 and pool.free_blocks == 4
+    assert (pool.page_table[a, :2] != pool.num_blocks).all()
+    assert (pool.page_table[a, 2:] == pool.num_blocks).all()
+    pool.alloc_to(a, 5)  # idempotent
+    assert pool.allocated_blocks == 2
+    pool.alloc_to(a, 9)  # third page
+    assert pool.allocated_blocks == 3
+    with pytest.raises(ValueError, match="reserved only"):
+        pool.alloc_to(a, 13)  # beyond the reservation
+
+    b = pool.claim()
+    assert not pool.can_reserve(16)  # 4 pages > 3 unreserved
+    with pytest.raises(ValueError, match="cannot reserve"):
+        pool.reserve(b, 16)
+    pool.reserve(b, 12)
+    pool.release(a)  # blocks AND reservation come back
+    assert pool.allocated_blocks == 0
+    assert pool.unreserved_blocks == 3
+    assert (pool.page_table[a] == pool.num_blocks).all()
+    with pytest.raises(ValueError, match="not claimed"):
+        pool.release(a)
+    pool.release(b)
+    assert pool.unreserved_blocks == 6 and pool.free_blocks == 6
+
+
+def test_paged_pool_rejects_unaligned_max_len():
+    from gradaccum_tpu.models.gpt import GPTConfig
+    from gradaccum_tpu.serving import PagedCachePool
+
+    cfg = GPTConfig.tiny_for_tests()
+    with pytest.raises(ValueError, match="multiple of page_size"):
+        PagedCachePool(cfg, num_slots=2, max_len=10, page_size=4, num_blocks=4)
+
+
+# -- admission control --------------------------------------------------------
+
+
+def test_paged_admission_blocks_are_the_gate(tiny_lm):
+    """Plenty of slots, scarce blocks: admission must stall on BLOCKS
+    (recorded as such), head-of-line requests wait rather than starve, and
+    everything still completes."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=8, max_len=32, page_size=8,
+                    num_blocks=4)
+    rids = [engine.submit(np.ones(4, np.int32), 8) for _ in range(4)]
+    engine.run_until_idle()
+    assert all(engine.status[r] == "done" for r in rids)
+    assert engine.scheduler.stalls.get("no_free_blocks", 0) > 0
+    # slots were never the problem
+    assert engine.scheduler.stalls.get("no_free_slots", 0) == 0
+
+
+def test_paged_batch_admission_respects_block_budget(tiny_lm):
+    """Several queued requests admitted in ONE tick must not over-commit
+    the block pool (reservations from the same batch count)."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=4, max_len=32, page_size=8,
+                    num_blocks=4)
+    # each needs 2 blocks; only 2 fit at once
+    rids = [engine.submit(np.ones(4, np.int32), 8) for _ in range(3)]
+    engine.step()
+    running = [r for r in rids if engine.status[r] == "running"]
+    assert len(running) == 2
+    engine.run_until_idle()
+    assert all(engine.status[r] == "done" for r in rids)
+
+
+def test_paged_queuefull_names_the_bottleneck(tiny_lm):
+    """Backpressure tells the operator WHICH resource to grow."""
+    from gradaccum_tpu.serving import Engine, QueueFull, Scheduler
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=4, max_len=16, page_size=8,
+                    num_blocks=2, scheduler=Scheduler(max_queue=1))
+    engine.submit(np.ones(4, np.int32), 8)
+    engine.step()  # in a slot, both blocks reserved; 3 slots still free
+    engine.submit(np.ones(4, np.int32), 8)
+    with pytest.raises(QueueFull, match="no free KV blocks"):
+        engine.submit(np.ones(4, np.int32), 8)
+
+    engine2 = Engine(params, cfg, num_slots=1, max_len=16,
+                     scheduler=Scheduler(max_queue=1))
+    engine2.submit(np.ones(4, np.int32), 8)
+    engine2.step()
+    engine2.submit(np.ones(4, np.int32), 8)
+    with pytest.raises(QueueFull, match="no free slots"):
+        engine2.submit(np.ones(4, np.int32), 8)
+
+
+def test_paged_submit_rejects_never_fitting_request(tiny_lm):
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=8,
+                    num_blocks=2)
+    with pytest.raises(ValueError, match="could never be admitted"):
+        engine.submit(np.ones(10, np.int32), 16)  # 4 blocks > pool's 2
+
+
+# -- metrics + manifest -------------------------------------------------------
+
+
+def test_paged_metrics_token_level_gauges(tiny_lm):
+    """Token occupancy / kv_bytes / waterline land in the summary, and the
+    paged pool's bytes-per-token beats the fixed pool's on short requests
+    (the entire point)."""
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+
+    def run(**kw):
+        engine = Engine(params, cfg, num_slots=4, max_len=32, **kw)
+        driver = SimulationDriver(engine, seed=2)
+        trace = driver.make_trace(8, arrival_rate=0.7, prompt_len=(1, 6),
+                                  max_new=(2, 6))
+        driver.run(trace)
+        return engine.metrics.summary()
+
+    fixed, paged = run(), run(page_size=4)
+    for m in (fixed, paged):
+        assert m["tokens_in_flight"]["count"] == m["ticks"]
+        assert 0 < m["token_occupancy"]["mean"] <= 1
+        assert m["kv_bytes_in_use"]["mean"] > 0
+        assert m["kv_bytes_per_token_in_flight"] > 0
+    assert paged["block_waterline"] is not None
+    assert fixed["block_waterline"] is None  # no blocks to run out of
+    # short requests in a max_len=32 fixed slot waste most of it
+    assert (paged["kv_bytes_per_token_in_flight"]
+            < 0.7 * fixed["kv_bytes_per_token_in_flight"])
+
+
+def test_paged_manifest_records_paging_knobs(tmp_path, tiny_lm):
+    from gradaccum_tpu.estimator.export import export_predict, load_manifest
+    from gradaccum_tpu.serving import Engine
+
+    cfg, bundle, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=4, max_len=32, page_size=8,
+                    num_blocks=12, decode_block_set=(1, 4))
+    sample = {"input_ids": np.zeros((2, 8), np.int32)}
+    export_predict(bundle.predict, params, sample, str(tmp_path),
+                   extra=engine.manifest())
+    manifest = load_manifest(str(tmp_path))
+    extra = manifest["extra"]
+    assert extra["page_size"] == 8
+    assert extra["num_blocks"] == 12
+    assert extra["decode_block_set"] == [1, 4]
+
+
+def test_server_stats_surface_block_state(tiny_lm):
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=16, page_size=4)
+    with ServingServer(engine) as srv:
+        h = srv.submit(np.ones(3, np.int32), 3)
+        h.result(timeout=60)
+        stats = srv.stats()
+    assert stats["num_kv_blocks"] == engine.pool.num_blocks
+    assert stats["kv_token_capacity"] == engine.pool.token_capacity
+    assert "free_kv_blocks" in stats
+    assert stats["metrics"]["tokens_emitted"] == 3
+
+
+# -- resilience interop -------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_paged_engine_recovers_from_tick_fault(tiny_lm):
+    """The resilience contract holds for the paged pool: a mid-tick crash
+    releases slots AND blocks; the rebuilt pool decodes the replayed
+    request to the exact greedy output."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.resilience.faults import (
+        FaultInjector,
+        FaultSchedule,
+        FaultSpec,
+    )
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    prompt = np.arange(1, 6, dtype=np.int32)
+    engine = Engine(params, cfg, num_slots=2, max_len=16, page_size=4)
+    inj = FaultInjector(FaultSchedule([FaultSpec(faults.MID_DECODE_TICK,
+                                                 at=2)]))
+    with faults.installed(inj):
+        with ServingServer(engine, max_requeues=2) as srv:
+            h = srv.submit(prompt, 6)
+            toks, reason = h.result(timeout=60)
+    assert inj.fired  # the crash actually happened
+    want = np.asarray(generate_cached(params, cfg, prompt, 6))[0, 5:]
+    np.testing.assert_array_equal(np.asarray(toks), want)
+    assert reason == "length"
+    assert engine.pool.allocated_blocks == 0
+    assert engine.pool.unreserved_blocks == engine.pool.num_blocks
+
+
+# -- bench (slow lane) --------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_paged_fast(tmp_path):
+    """The paged-vs-fixed bench end-to-end at --fast shapes: the artifact
+    must carry both legs and the comparison fields BENCH_paged.json
+    promises, and the equal-memory acceptance must hold even tiny."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from examples.bench_serving import main as bench_main
+
+    out = tmp_path / "BENCH_paged.json"
+    result = bench_main(["--paged", "--fast", "--out", str(out)])
+    assert out.exists()
+    for leg in (result["fixed"], result["paged"]):
+        assert leg["tokens_per_s"] > 0
+        assert leg["peak_concurrent_requests"] >= 1
+        assert leg["kv_bytes_per_token_in_flight"] > 0
+    assert result["fixed"]["kv_pool_bytes"] == result["paged"]["kv_pool_bytes"]
+    assert result["paged"]["block_pool_waterline"] is not None
+    assert result["paged"]["decode_programs"] == 1
+    assert result["acceptance"]["passed"]

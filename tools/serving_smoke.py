@@ -4,18 +4,26 @@ Checks the properties that matter, not perf: (1) greedy outputs through
 the continuous-batching engine are token-for-token identical to solo
 ``generate_cached``; (2) the decode tick compiled exactly once; (3) the
 threaded server streams and drains cleanly; (4) the export manifest
-round-trips the engine knobs. Exit code 0 = PASS.
+round-trips the engine knobs. ``--paged`` runs the same gates through the
+paged KV pool (page tables, block reservations, reclaim-at-idle) instead
+of the fixed-slot pool. Exit code 0 = PASS.
 
-Usage: python tools/serving_smoke.py
+Usage: python tools/serving_smoke.py [--paged]
 """
 
+import argparse
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--paged", action="store_true",
+                    help="run the smoke through the paged KV pool")
+    args = ap.parse_args(argv)
+
     import numpy as np
 
     import jax
@@ -28,11 +36,14 @@ def main():
     bundle = gpt_lm_bundle(cfg)
     params = bundle.init(jax.random.PRNGKey(0),
                          {"input_ids": np.zeros((1, 8), np.int32)})
+    paged_kw = dict(page_size=4) if args.paged else {}
+    mode = "paged" if args.paged else "fixed"
 
     failures = []
 
     # 1+2: seeded trace parity + compile-once
-    engine = Engine(params, cfg, num_slots=4, max_len=32, decode_block=4)
+    engine = Engine(params, cfg, num_slots=4, max_len=32, decode_block=4,
+                    **paged_kw)
     driver = SimulationDriver(engine, seed=0)
     trace = driver.make_trace(8, arrival_rate=0.6, prompt_len=(1, 12),
                               max_new=(1, 12))
@@ -46,24 +57,35 @@ def main():
         failures.append(
             f"decode tick compiled {engine.decode_compile_count()}x, want 1"
         )
-    print(f"parity: {len(records)} requests, "
+    if args.paged and engine.pool.allocated_blocks != 0:
+        failures.append(
+            f"{engine.pool.allocated_blocks} KV blocks leaked at idle"
+        )
+    print(f"parity ({mode}): {len(records)} requests, "
           f"{engine.metrics.summary()['tokens_emitted']} tokens, "
           f"decode programs={engine.decode_compile_count()}")
 
     # 3: threaded server streams
     rng = np.random.default_rng(1)
     prompt = rng.integers(0, cfg.vocab_size, 5).astype(np.int32)
-    with ServingServer(Engine(params, cfg, num_slots=2, max_len=24)) as srv:
+    with ServingServer(
+        Engine(params, cfg, num_slots=2, max_len=24, **paged_kw)
+    ) as srv:
         toks, reason = srv.submit(prompt, 6).result(timeout=60)
+        stats = srv.stats()
     want = np.asarray(generate_cached(params, cfg, prompt, 6))[0, 5:]
     if not (reason == "length" and np.array_equal(np.asarray(toks), want)):
         failures.append(f"server stream mismatch: {toks} ({reason}) vs {want}")
+    if args.paged and "free_kv_blocks" not in stats:
+        failures.append(f"server stats missing block state: {stats}")
     print(f"server: streamed {len(toks)} tokens, finish={reason}")
 
     # 4: manifest knobs round-trip
     m = engine.manifest()
     if m["num_slots"] != 4 or m["max_len"] != 32 or m["decode_block"] != 4:
         failures.append(f"manifest knobs wrong: {m}")
+    if args.paged and m["page_size"] != 4:
+        failures.append(f"manifest paging knobs wrong: {m}")
 
     if failures:
         print("FAIL:\n  " + "\n  ".join(failures))
