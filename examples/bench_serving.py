@@ -27,7 +27,18 @@ so short requests stack. Reported per pool: peak concurrent requests,
 tokens/s, KV bytes per token in flight, block-pool waterline. Acceptance:
 ≥2× peak concurrency at equal memory, or ≥30% lower KV bytes per token.
 
-Usage: python examples/bench_serving.py [--out FILE] [--fast] [--paged]
+``--prefix`` runs the shared-prefix comparison → BENCH_prefix.json: the
+SAME paged engine at EQUAL pool memory serves a shared-system-prompt
+workload (one long system prefix, short unique tails; a leader arrives
+one tick early, then the flood) with the prefix cache OFF vs ON. ON,
+followers map their leading page-table entries onto the leader's blocks
+and prefill only their tails, so the prefill bill and the KV bytes per
+token in flight both drop roughly with the shared fraction. Acceptance:
+prefill tokens computed reduced ≥2×, KV bytes/token ratio ≤0.7, and the
+compile-once assertion intact (decode programs == 1 in BOTH legs).
+
+Usage: python examples/bench_serving.py [--out FILE] [--fast]
+                                        [--paged | --prefix]
 (``--fast`` shrinks everything for the `slow`-marked CI test.)
 """
 
@@ -174,8 +185,10 @@ def _longtail_workload(cfg, fast, rng):
     return shape, work
 
 
-def _run_closed(eng, work):
-    """Closed load; returns (tokens_per_s, peak_concurrent_requests)."""
+def _run_closed(eng, work, rng_seed_base=0):
+    """Closed load; returns (elapsed_s, peak_concurrent_requests). Runs
+    until the ENGINE is idle, so requests already in flight when the load
+    starts (the --prefix leader) are drained and counted in the peak."""
     from gradaccum_tpu.serving import QueueFull
 
     pending = list(enumerate(work))
@@ -185,7 +198,7 @@ def _run_closed(eng, work):
         still = []
         for i, (p, n) in pending:
             try:
-                eng.submit(p, n, rng_seed=i)
+                eng.submit(p, n, rng_seed=rng_seed_base + i)
             except QueueFull:
                 still.append((i, (p, n)))
         pending = still
@@ -194,8 +207,7 @@ def _run_closed(eng, work):
         # still active plus the ones the tick itself retired (a short
         # request can be admitted and fully decoded inside one block)
         peak = max(peak, eng.pool.active_count + len(ev.finished))
-    dt = time.perf_counter() - t0
-    return sum(n for _, n in work) / dt, peak
+    return time.perf_counter() - t0, peak
 
 
 def bench_paged(cfg, params, fast):
@@ -225,7 +237,8 @@ def bench_paged(cfg, params, fast):
         _run_closed(eng, work)  # warm pass: compiles tick + admit programs
         eng.metrics = ServingMetrics()  # timed pass starts clean
         eng.scheduler.stalls.clear()
-        tps, peak = _run_closed(eng, work)
+        elapsed, peak = _run_closed(eng, work)
+        tps = sum(n for _, n in work) / elapsed
         m = eng.metrics.summary()
         results = {
             "tokens_per_s": tps,
@@ -270,6 +283,148 @@ def bench_paged(cfg, params, fast):
     }
 
 
+def _prefix_workload(cfg, fast, rng):
+    """One shared system prompt + short unique tails: the workload where
+    prefix sharing pays (most of every prompt is the same bytes)."""
+    if fast:
+        shape = dict(max_len=64, sys_len=24, tail=(2, 6), new=(4, 8), n=8,
+                     num_slots=8, page_size=4, decode_block=2)
+    else:
+        shape = dict(max_len=128, sys_len=64, tail=(4, 12), new=(8, 16),
+                     n=24, num_slots=16, page_size=8, decode_block=8)
+    import numpy as np
+
+    sys_prompt = rng.integers(0, cfg.vocab_size,
+                              shape["sys_len"]).astype(np.int32)
+    work = []
+    for _ in range(shape["n"]):
+        tail = rng.integers(0, cfg.vocab_size,
+                            int(rng.integers(*shape["tail"]) + 1))
+        work.append((
+            np.concatenate([sys_prompt, tail.astype(np.int32)]),
+            int(rng.integers(*shape["new"]) + 1),
+        ))
+    return shape, work
+
+
+def _run_leader_flood(eng, work):
+    """Leader first (one tick head start, so its prefix pages are indexed
+    before anyone else admits), then the flood via :func:`_run_closed`.
+    Returns (tokens_per_s, peak_concurrent_requests); the timer covers the
+    head-start tick too, so every token counted is also timed."""
+    t0 = time.perf_counter()
+    eng.submit(work[0][0], work[0][1], rng_seed=0)
+    eng.step()  # leader admitted; its full pages are now indexed
+    _, peak = _run_closed(eng, work[1:], rng_seed_base=1)
+    return sum(n for _, n in work) / (time.perf_counter() - t0), peak
+
+
+def bench_prefix(cfg, params, fast):
+    """Prefix cache OFF vs ON on the same paged engine, equal pool memory,
+    shared-system-prompt workload."""
+    from gradaccum_tpu.serving import Engine, Scheduler, ServingMetrics
+
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    shape, work = _prefix_workload(cfg, fast, rng)
+    num_blocks = shape["num_slots"] * shape["max_len"] // shape["page_size"]
+
+    def leg(prefix):
+        eng = Engine(
+            params, cfg, num_slots=shape["num_slots"],
+            max_len=shape["max_len"], page_size=shape["page_size"],
+            num_blocks=num_blocks, decode_block=shape["decode_block"],
+            prefix_cache=prefix,
+            scheduler=Scheduler(max_queue=4 * len(work)),
+        )
+        _run_leader_flood(eng, work)   # warm pass compiles tick + admits
+        eng.metrics = ServingMetrics()  # timed pass starts clean
+        eng.scheduler.stalls.clear()
+        tps, peak = _run_leader_flood(eng, work)
+        m = eng.metrics.summary()
+        return {
+            "tokens_per_s": tps,
+            "peak_concurrent_requests": peak,
+            "prefill_tokens_computed": m["prefill_tokens_computed"],
+            "prefill_tokens_skipped": m["prefill_tokens_skipped"],
+            "prefix_hit_rate": m["prefix_hit_rate"],
+            "blocks_saved": m["blocks_saved"],
+            "shared_blocks_peak": m["shared_blocks_peak"],
+            "kv_bytes_per_token_in_flight":
+                m["kv_bytes_per_token_in_flight"],
+            "kv_pool_bytes": num_blocks * shape["page_size"]
+                * eng._token_bytes,
+            "ttft_s_p50": m["ttft"]["p50"],
+            "decode_programs": eng.decode_compile_count(),
+            "prefill_programs": eng.prefill_compile_count(),
+            "num_slots": eng.pool.num_slots,
+            "num_blocks": num_blocks,
+        }
+
+    off = leg(prefix=False)
+    on = leg(prefix=True)
+    prefill_reduction = (off["prefill_tokens_computed"]
+                         / on["prefill_tokens_computed"])
+    kv_ratio = (on["kv_bytes_per_token_in_flight"]
+                / off["kv_bytes_per_token_in_flight"])
+    # compile-once must cover ADMISSION too: the prefix leg may add at most
+    # its second admit family's programs, never traffic-proportional ones
+    compile_once = (off["decode_programs"] == 1
+                    and on["decode_programs"] == 1
+                    and on["prefill_programs"]
+                    <= off["prefill_programs"] + 2)
+    return {
+        "bench": "shared-prefix KV blocks: prefix cache off vs on at "
+                 "equal pool memory",
+        "workload": {
+            **{k: v for k, v in shape.items()},
+            "n_requests": len(work),
+            "total_new_tokens": sum(n for _, n in work),
+            "shared_fraction_mean": float(np.mean(
+                [shape["sys_len"] / p.size for p, _ in work]
+            )),
+        },
+        "off": off,
+        "on": on,
+        "prefill_reduction": prefill_reduction,
+        "kv_bytes_per_token_ratio": kv_ratio,
+        "prefix_speedup": on["tokens_per_s"] / off["tokens_per_s"],
+        "acceptance": {
+            "required": "prefill_reduction >= 2.0 and kv ratio <= 0.7 "
+                        "and decode_programs == 1 both legs and prefix "
+                        "admit programs bounded (off + <= 2)",
+            "passed": (prefill_reduction >= 2.0 and kv_ratio <= 0.7
+                       and compile_once),
+        },
+    }
+
+
+def _finalize(result, cfg, out):
+    """Attach the platform/model blocks every BENCH artifact carries and
+    write it — one epilogue for all three comparisons, so the artifact
+    format can't silently diverge between them."""
+    import jax
+
+    result["platform"] = {
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "cpu_count": os.cpu_count(),
+    }
+    result["model"] = {
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "num_layers": cfg.num_layers,
+        "num_heads": cfg.num_heads,
+        "intermediate_size": cfg.intermediate_size,
+    }
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {out}")
+    return result
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
@@ -277,27 +432,39 @@ def main(argv=None):
                     help="small shapes for the CI slow-lane test")
     ap.add_argument("--paged", action="store_true",
                     help="fixed-vs-paged pool comparison -> BENCH_paged.json")
+    ap.add_argument("--prefix", action="store_true",
+                    help="prefix-cache off-vs-on comparison -> "
+                         "BENCH_prefix.json")
     args = ap.parse_args(argv)
+    if args.paged and args.prefix:
+        ap.error("--paged and --prefix are separate comparisons")
     if args.out is None:
-        args.out = "BENCH_paged.json" if args.paged else "BENCH_serving.json"
+        args.out = ("BENCH_prefix.json" if args.prefix
+                    else "BENCH_paged.json" if args.paged
+                    else "BENCH_serving.json")
 
     import jax
 
     cfg, params, prompts, knobs = _build(args.fast)
 
+    if args.prefix:
+        result = bench_prefix(cfg, params, args.fast)
+        for name in ("off", "on"):
+            leg = result[name]
+            print(f"prefix {name:>3}: {leg['tokens_per_s']:.1f} tok/s, "
+                  f"prefill computed {leg['prefill_tokens_computed']} "
+                  f"skipped {leg['prefill_tokens_skipped']}, "
+                  f"{leg['kv_bytes_per_token_in_flight']:.0f} KV B/token, "
+                  f"ttft p50 {leg['ttft_s_p50']:.4f}s", flush=True)
+        print(f"prefill reduction {result['prefill_reduction']:.2f}x, "
+              f"kv bytes/token ratio "
+              f"{result['kv_bytes_per_token_ratio']:.2f}, "
+              f"hit rate {result['on']['prefix_hit_rate']:.2f}, "
+              f"acceptance passed={result['acceptance']['passed']}")
+        return _finalize(result, cfg, args.out)
+
     if args.paged:
         result = bench_paged(cfg, params, args.fast)
-        result["platform"] = {
-            "backend": jax.default_backend(),
-            "device": str(jax.devices()[0]),
-            "cpu_count": os.cpu_count(),
-        }
-        result["model"] = {
-            "vocab_size": cfg.vocab_size,
-            "hidden_size": cfg.hidden_size,
-            "num_layers": cfg.num_layers,
-            "num_heads": cfg.num_heads,
-        }
         print(f"fixed ({result['fixed']['num_slots']} slots): "
               f"{result['fixed']['tokens_per_s']:.1f} tok/s, "
               f"peak {result['fixed']['peak_concurrent_requests']} "
@@ -315,11 +482,7 @@ def main(argv=None):
               f"kv bytes/token ratio {result['kv_bytes_per_token_ratio']:.2f}, "
               f"speedup {result['paged_speedup']:.2f}x, "
               f"acceptance passed={result['acceptance']['passed']}")
-        with open(args.out, "w") as f:
-            json.dump(result, f, indent=1, sort_keys=True)
-            f.write("\n")
-        print(f"wrote {args.out}")
-        return result
+        return _finalize(result, cfg, args.out)
 
     serial_tps = bench_serial(cfg, params, prompts, knobs)
     print(f"serial: {serial_tps:.1f} tok/s", flush=True)
@@ -346,18 +509,6 @@ def main(argv=None):
 
     result = {
         "bench": "continuous-batching serving engine",
-        "platform": {
-            "backend": jax.default_backend(),
-            "device": str(jax.devices()[0]),
-            "cpu_count": os.cpu_count(),
-        },
-        "model": {
-            "vocab_size": cfg.vocab_size,
-            "hidden_size": cfg.hidden_size,
-            "num_layers": cfg.num_layers,
-            "num_heads": cfg.num_heads,
-            "intermediate_size": cfg.intermediate_size,
-        },
         "workload": knobs,
         "serial_tokens_per_s": serial_tps,
         "engine": engine_leg,
@@ -365,11 +516,7 @@ def main(argv=None):
         "sweep": sweep,
         "acceptance": {"required_speedup": 3.0, "passed": speedup >= 3.0},
     }
-    with open(args.out, "w") as f:
-        json.dump(result, f, indent=1, sort_keys=True)
-        f.write("\n")
-    print(f"wrote {args.out}")
-    return result
+    return _finalize(result, cfg, args.out)
 
 
 if __name__ == "__main__":

@@ -107,6 +107,32 @@ def _lm_head(params, cfg: GPTConfig, x):
     )
 
 
+def _ragged_self_mask(cfg: GPTConfig, s0: int, pad):
+    """Additive attention mask for a LEFT-padded ragged batch: query i sees
+    key j iff causal (j <= i) and j is a real (non-pad) column. Shared by
+    the ragged :func:`prefill` branch and :func:`_prefill_suffix` so the
+    two paths can never drift apart. Returns [B, 1, S0, S0]."""
+    causal = jnp.tril(jnp.ones((s0, s0), jnp.float32))
+    real = (jnp.arange(s0)[None, :] >= pad[:, None]).astype(jnp.float32)
+    visible = causal[None] * real[:, None, :]  # [B, S0, S0]
+    return ((1.0 - visible) * -1e9).astype(cfg.dtype)[:, None]
+
+
+def _compact_ragged(k_stack, v_stack, pad, lengths, out_len: int):
+    """Left-shift a ragged batch's stacked K/V so row b's real positions
+    land at ``[0, lengths[b])`` of an ``out_len``-long axis, zeros after
+    (free tail positions stay inert). The one compaction both prefill
+    paths use. ``k_stack``/``v_stack``: [L, B, H, S0, hd]."""
+    s0 = k_stack.shape[3]
+    idx = jnp.clip(jnp.arange(out_len)[None, :] + pad[:, None], 0, s0 - 1)
+    keep = jnp.arange(out_len)[None, :] < lengths[:, None]  # [B, out_len]
+    idx5 = idx[None, :, None, :, None]
+    keep5 = keep[None, :, None, :, None]
+    k_stack = jnp.where(keep5, jnp.take_along_axis(k_stack, idx5, axis=3), 0)
+    v_stack = jnp.where(keep5, jnp.take_along_axis(v_stack, idx5, axis=3), 0)
+    return k_stack, v_stack
+
+
 def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> DecodeCache:
     if max_len > cfg.max_position_embeddings:
         raise ValueError(
@@ -159,10 +185,7 @@ def prefill(params, cfg: GPTConfig, prompt_ids, max_len: int, lengths=None):
             )
         pad = s0 - lengths  # [B] left-pad per row
         positions = jnp.maximum(jnp.arange(s0)[None, :] - pad[:, None], 0)
-        # key j visible to query i iff causal AND j is a real token
-        real = (jnp.arange(s0)[None, :] >= pad[:, None]).astype(jnp.float32)
-        visible = causal[None] * real[:, None, :]  # [B, S0, S0]
-        pos_mask = ((1.0 - visible) * -1e9).astype(cfg.dtype)[:, None]
+        pos_mask = _ragged_self_mask(cfg, s0, pad)
     else:
         positions = jnp.arange(s0)[None, :]
         pos_mask = ((1.0 - causal) * -1e9).astype(cfg.dtype)[None, None]
@@ -181,14 +204,8 @@ def prefill(params, cfg: GPTConfig, prompt_ids, max_len: int, lengths=None):
 
     k_stack, v_stack = jnp.stack(ks), jnp.stack(vs)  # [L, B, H, S0, hd]
     if ragged:
-        # compact: cache position t takes prompt column t + pad (left shift),
-        # zeroed past each row's length so free tail positions stay inert
-        idx = jnp.clip(jnp.arange(max_len)[None, :] + pad[:, None], 0, s0 - 1)
-        keep = jnp.arange(max_len)[None, :] < lengths[:, None]  # [B, T]
-        idx5 = idx[None, :, None, :, None]
-        keep5 = keep[None, :, None, :, None]
-        k_stack = jnp.where(keep5, jnp.take_along_axis(k_stack, idx5, axis=3), 0)
-        v_stack = jnp.where(keep5, jnp.take_along_axis(v_stack, idx5, axis=3), 0)
+        k_stack, v_stack = _compact_ragged(k_stack, v_stack, pad, lengths,
+                                           max_len)
         length = lengths
     else:
         tail = ((0, 0), (0, 0), (0, 0), (0, max_len - s0), (0, 0))
@@ -333,7 +350,12 @@ def decode_step_paged(params, cfg: GPTConfig, pool_k, pool_v, page_table,
     outlive a request's budget (the fixed pool absorbs them in its slack up
     to ``max_len``) drop their writes instead of demanding pages beyond the
     reservation. Tokens within the budget are unaffected: the n-th emitted
-    token only needs writes at positions < prompt + n - 1.
+    token only needs writes at positions < prompt + n - 1. Together with
+    the engine's admission lengths the budget also brackets writes from
+    BELOW for prefix sharing: decode writes start at ``lengths[b]`` — the
+    full prompt length, strictly past any shared-prefix region — so shared
+    blocks mapped by several page-table rows are read-only here by
+    construction, no copy-on-write needed.
 
     Reads gather each slot's pages into a virtual ``[B, H, max_pages *
     page_size, hd]`` view (the write for this token lands first, so the
@@ -389,7 +411,8 @@ def decode_step_paged(params, cfg: GPTConfig, pool_k, pool_v, page_table,
 
 
 def prefill_paged(params, cfg: GPTConfig, prompt_ids, prompt_lens,
-                  pool_k, pool_v, page_rows):
+                  pool_k, pool_v, page_rows, start_lens=None,
+                  read_tables=None):
     """Ragged batched prefill straight into pool blocks.
 
     ``prompt_ids`` [B, S0] left-padded, ``prompt_lens`` [B]; ``page_rows``
@@ -401,6 +424,23 @@ def prefill_paged(params, cfg: GPTConfig, prompt_ids, prompt_lens,
     allocated page's tail, where decode writes will overwrite them), then
     scatters page-size chunks into the pool. Returns ``(pool_k, pool_v,
     last_logits)``.
+
+    **Suffix mode** (``start_lens`` [B] int32, each a PAGE-ALIGNED token
+    count): row b's true prompt begins with ``start_lens[b]`` tokens whose
+    K/V already live in pool blocks (a prefix-cache hit); ``prompt_ids`` /
+    ``prompt_lens`` then describe only the UNSHARED TAIL. The tail runs
+    through the model at global positions ``start_lens[b] + j``, attending
+    jointly to (a) the shared prefix gathered from the pool through
+    ``read_tables`` [B, P] — the rows' LEADING page-table entries, P pages
+    covering at least the batch's largest shared region (the engine
+    buckets P so the gather extent tracks the prefix, not ``max_len``);
+    entries at or past each row's prefix are masked out, so sentinel /
+    not-yet-written pages never contribute — and (b) the tail's own K/V
+    under the usual ragged causal mask. Writes are unchanged page-chunk scatters via
+    ``page_rows``, which in this mode hold the SUFFIX region's pages only:
+    the shared region is structurally unwritable (its pages simply are not
+    in the scatter index). Page alignment of ``start_lens`` makes suffix
+    chunk j land at page ``start_pages + j`` with zero offset skew.
     """
     b, s0 = prompt_ids.shape
     page_size = pool_k.shape[3]
@@ -410,18 +450,90 @@ def prefill_paged(params, cfg: GPTConfig, prompt_ids, prompt_lens,
             f"page_rows must be [batch={b}, ceil(S0/page)={s0_pages}], "
             f"got {page_rows.shape}"
         )
-    cache, logits = prefill(params, cfg, prompt_ids, s0_pages * page_size,
-                            lengths=prompt_lens)
+    if start_lens is None:
+        cache, logits = prefill(params, cfg, prompt_ids, s0_pages * page_size,
+                                lengths=prompt_lens)
+        k_stack, v_stack = cache.k, cache.v
+    else:
+        if read_tables is None:
+            raise ValueError("suffix mode needs read_tables (the full "
+                             "page-table rows for reading the shared prefix)")
+        k_stack, v_stack, logits = _prefill_suffix(
+            params, cfg, prompt_ids, prompt_lens, start_lens,
+            pool_k, pool_v, read_tables, s0_pages * page_size,
+        )
     # [L, B, H, s0p*P, hd] -> [L, B, s0p, H, P, hd] page-sized chunks
-    num_layers, _, heads, _, hd = cache.k.shape
+    num_layers, _, heads, _, hd = k_stack.shape
     chunked = (num_layers, b, heads, s0_pages, page_size, hd)
 
     def to_pages(t):
         return t.reshape(chunked).transpose(0, 1, 3, 2, 4, 5)
 
-    pool_k = pool_k.at[:, page_rows].set(to_pages(cache.k).astype(pool_k.dtype))
-    pool_v = pool_v.at[:, page_rows].set(to_pages(cache.v).astype(pool_v.dtype))
+    pool_k = pool_k.at[:, page_rows].set(to_pages(k_stack).astype(pool_k.dtype))
+    pool_v = pool_v.at[:, page_rows].set(to_pages(v_stack).astype(pool_v.dtype))
     return pool_k, pool_v, logits
+
+
+def _prefill_suffix(params, cfg: GPTConfig, suffix_ids, suffix_lens,
+                    start_lens, pool_k, pool_v, read_tables, out_len):
+    """The suffix-mode body of :func:`prefill_paged`: run only the unshared
+    tail tokens, attending to the shared prefix's pooled K/V. Returns the
+    tail's compacted ``(k_stack, v_stack)`` [L, B, H, out_len, hd] (tail
+    position j at index j, zeros past each row's length) plus the last real
+    token's next-token logits — exactly the contract the page-chunk scatter
+    and the admission sampler expect.
+
+    The prefix is gathered ONCE per layer from the pool INPUT arrays, so
+    within this program reads see only pages written by earlier dispatches
+    — the very pages the prefix mask exposes (positions < start_lens[b]);
+    the tail's own pages, written after this returns, are masked out here.
+    """
+    b, s0 = suffix_ids.shape
+    num_heads = cfg.num_heads
+    page_size = pool_k.shape[3]
+    max_pages = read_tables.shape[1]
+    t_virt = max_pages * page_size
+    suffix_lens = jnp.asarray(suffix_lens, jnp.int32)
+    start_lens = jnp.asarray(start_lens, jnp.int32)
+    pad = s0 - suffix_lens  # [B] left-pad per row
+    positions = start_lens[:, None] + jnp.maximum(
+        jnp.arange(s0)[None, :] - pad[:, None], 0
+    )
+    x = _embed(params, cfg, suffix_ids, positions)
+    # tail-internal visibility: the standard ragged mask
+    self_mask = _ragged_self_mask(cfg, s0, pad)  # [B, 1, S0, S0]
+    # prefix visibility: virtual position t is a shared-prefix key iff
+    # t < start_lens[b] — every tail query sits at a later position, so no
+    # causal term is needed on this side
+    vis_pref = jnp.arange(t_virt)[None, :] < start_lens[:, None]
+    pref_mask = jnp.where(vis_pref, 0.0, -1e9).astype(cfg.dtype)
+    pref_mask = jnp.broadcast_to(pref_mask[:, None, None, :],
+                                 (b, 1, s0, t_virt))
+
+    ks, vs = [], []
+    p = params["params"]
+    for i in range(cfg.num_layers):
+
+        def attend_mixed(q, k, v, i=i):
+            kv_shape = (b, num_heads, t_virt, k.shape[-1])
+            k_pref = pool_k[i][read_tables] \
+                .transpose(0, 2, 1, 3, 4).reshape(kv_shape)
+            v_pref = pool_v[i][read_tables] \
+                .transpose(0, 2, 1, 3, 4).reshape(kv_shape)
+            k_all = jnp.concatenate([k_pref.astype(k.dtype), k], axis=2)
+            v_all = jnp.concatenate([v_pref.astype(v.dtype), v], axis=2)
+            mask = jnp.concatenate([pref_mask, self_mask], axis=-1)
+            return _attend(q, k_all, v_all, mask), (k, v)
+
+        x, (k, v) = _block(cfg, p[f"layer_{i}"], x, attend_mixed)
+        ks.append(k)
+        vs.append(v)
+
+    k_stack, v_stack = jnp.stack(ks), jnp.stack(vs)  # [L, B, H, S0, hd]
+    k_stack, v_stack = _compact_ragged(k_stack, v_stack, pad, suffix_lens,
+                                       out_len)
+    logits = _lm_head(params, cfg, x[:, -1:, :])[:, 0]
+    return k_stack, v_stack, logits
 
 
 def _top_k_mask(logits, k: int):

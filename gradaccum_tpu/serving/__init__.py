@@ -11,13 +11,21 @@ either slot-granular (``CachePool``: every request holds ``max_len``
 positions) or PAGED (``PagedCachePool`` + ``Engine(page_size=...)``:
 fixed-size blocks handed out as lengths grow, addressed through per-slot
 page tables that are just gather indices — pool memory scales with tokens
-in flight while every shape stays static). Admission
+in flight while every shape stays static). Paged blocks are refcounted, so
+``Engine(prefix_cache=True)`` lets requests with identical prompt prefixes
+map their page tables onto the SAME blocks (``PrefixCache`` hashes
+page-aligned prompt chunks at admission) and prefill only their unshared
+tails. Admission
 control with backpressure and deadlines lives in ``scheduler``; a threaded
 front-end plus a deterministic seeded simulation driver in ``server``;
 TTFT / throughput / occupancy telemetry in ``metrics``.
 """
 
-from gradaccum_tpu.serving.cache_pool import CachePool, PagedCachePool
+from gradaccum_tpu.serving.cache_pool import (
+    CachePool,
+    PagedCachePool,
+    PrefixCache,
+)
 from gradaccum_tpu.serving.engine import Engine, StepEvents
 from gradaccum_tpu.serving.metrics import ServingMetrics
 from gradaccum_tpu.serving.scheduler import QueueFull, Request, Scheduler
@@ -30,6 +38,7 @@ from gradaccum_tpu.serving.server import (
 __all__ = [
     "CachePool",
     "PagedCachePool",
+    "PrefixCache",
     "Engine",
     "StepEvents",
     "ServingMetrics",

@@ -29,11 +29,24 @@ Releasing a slot reclaims its blocks and reservation; like the fixed pool,
 stale block contents need no device work (attention masks positions past
 each slot's length, and re-allocated pages are overwritten before they
 become visible).
+
+Blocks are REFCOUNTED so requests with identical prompt prefixes can map
+their page-table entries to the SAME blocks (:class:`PrefixCache` is the
+index that finds them): a block is freed only when its refcount hits zero,
+so a sharer retiring early — EOS, cancel, fault recovery — never yanks
+pages out from under the other users. Prefix pages are read-only once
+written (every writer's pages start strictly after its shared region), so
+there is no copy-on-write. Reservation accounting stays truthful under
+sharing via ORPHAN tracking: a live shared block is covered either by its
+allocating slot's reservation or — once that slot releases — by the orphan
+count, so ``unreserved_blocks`` never promises memory that shared survivors
+are still holding.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+import hashlib
+from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -121,6 +134,87 @@ class CachePool(_SlotLedger):
         return DecodeCache(k=self.k, v=self.v, length=self.lengths)
 
 
+class PrefixCache:
+    """Host-side index from page-aligned prompt chunks to live pool blocks.
+
+    Chunk ``i`` of a prompt covers tokens ``[i*page_size, (i+1)*page_size)``
+    and is keyed by a CUMULATIVE hash of tokens ``[0, (i+1)*page_size)`` —
+    matching chunk ``i`` therefore implies every earlier chunk matches too,
+    so a lookup is just "walk chunks until the first miss". Entries point at
+    blocks whose contents are exactly that chunk's K/V; the pool invalidates
+    them the instant a block's refcount hits zero (``forget_block``), so the
+    index can never hand out a recycled page. No entry ever outlives its
+    block: sharing happens between temporally overlapping requests, and an
+    idle pool implies an empty index.
+    """
+
+    def __init__(self, page_size: int):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.page_size = int(page_size)
+        self._by_hash: Dict[str, int] = {}   # chunk hash -> block id
+        self._by_block: Dict[int, str] = {}  # block id -> its chunk hash
+
+    def __len__(self) -> int:
+        return len(self._by_hash)
+
+    def _keys(self, prompt: np.ndarray, n_chunks: int):
+        """Yield the first ``n_chunks`` cumulative chunk keys in ONE pass:
+        a running sha1 fed page-sized slices, snapshotted per chunk —
+        O(prompt) total, not O(prompt^2) (match runs on the admission hot
+        path, including every tick a stalled queue head is re-judged)."""
+        data = np.ascontiguousarray(prompt, np.int32)
+        h = hashlib.sha1()
+        for chunk in range(n_chunks):
+            h.update(
+                data[chunk * self.page_size:(chunk + 1) * self.page_size]
+                .tobytes()
+            )
+            yield h.copy().hexdigest()
+
+    def match(self, prompt: np.ndarray) -> List[int]:
+        """Block ids for the longest indexed prefix of ``prompt``, STRICTLY
+        shorter than the prompt: at least one trailing token is always left
+        to prefill (a request needs its last prompt token's logits, and the
+        next admission's suffix writes must start after the shared region).
+        """
+        prompt = np.asarray(prompt).reshape(-1)
+        limit = (prompt.size - 1) // self.page_size
+        blocks: List[int] = []
+        for key in self._keys(prompt, limit):
+            block = self._by_hash.get(key)
+            if block is None:
+                break
+            blocks.append(block)
+        return blocks
+
+    def insert(self, prompt: np.ndarray, blocks: List[int]) -> None:
+        """Register ``prompt``'s leading full-page chunks as backed by
+        ``blocks`` (one block per chunk, in order). Chunks already indexed
+        are skipped — the first writer's block stays canonical, so two
+        same-prefix requests admitted in one batch (which cannot share: the
+        index is consulted before their joint prefill dispatch) don't
+        thrash the entry."""
+        prompt = np.asarray(prompt).reshape(-1)
+        for block, key in zip(blocks, self._keys(prompt, len(blocks))):
+            if key in self._by_hash:
+                continue
+            block = int(block)
+            self._by_hash[key] = block
+            self._by_block[block] = key
+
+    def forget_block(self, block: int) -> None:
+        """Drop the entry backed by ``block`` (the pool calls this when the
+        block's refcount hits zero — its contents are about to be reused)."""
+        key = self._by_block.pop(int(block), None)
+        if key is not None:
+            self._by_hash.pop(key, None)
+
+    def clear(self) -> None:
+        self._by_hash.clear()
+        self._by_block.clear()
+
+
 class PagedCachePool(_SlotLedger):
     """Slot + block bookkeeping (host) and the paged pool arrays (device).
 
@@ -129,10 +223,20 @@ class PagedCachePool(_SlotLedger):
     cache extent (``max_pages = ceil(max_len / page_size)`` page-table
     columns). Unassigned page-table entries hold the sentinel
     ``num_blocks`` (dropped-write semantics in the compiled step).
+
+    Every block carries a REFCOUNT. ``alloc_to`` hands out blocks at ref 1
+    owned by the allocating slot; ``adopt_shared`` maps another slot's
+    leading page-table entries onto existing blocks (incref, no device
+    work). ``release`` decrefs every block the slot maps and frees only
+    those that hit zero; a still-referenced block whose allocating owner
+    just released becomes an ORPHAN — alive, but covered by no slot's
+    reservation — and ``unreserved_blocks`` subtracts orphans so admission
+    can never promise memory that shared survivors still occupy.
     """
 
     def __init__(self, cfg: GPTConfig, num_slots: int, max_len: int,
-                 page_size: int, num_blocks: int):
+                 page_size: int, num_blocks: int,
+                 prefix_cache: Optional[PrefixCache] = None):
         self._init_slots(num_slots)
         if max_len % page_size:
             # keeps a slot's virtual axis exactly max_pages * page_size and
@@ -145,27 +249,66 @@ class PagedCachePool(_SlotLedger):
                 f"max_len {max_len} exceeds max_position_embeddings "
                 f"{cfg.max_position_embeddings}"
             )
+        if prefix_cache is not None and prefix_cache.page_size != page_size:
+            raise ValueError(
+                f"prefix cache page_size {prefix_cache.page_size} != pool "
+                f"page_size {page_size}"
+            )
         self.k, self.v = init_paged_pool(cfg, num_blocks, page_size)
         self.lengths = jnp.zeros((num_slots,), jnp.int32)
         self.max_len = max_len
         self.page_size = page_size
         self.num_blocks = num_blocks
         self.max_pages = max_len // page_size
-        # host-side page-table mirror; uploaded per tick (tiny int32)
+        self.prefix_cache = prefix_cache
+        # host-side page-table mirror; uploaded on change (memoized device
+        # copy — see page_table_device)
         self.page_table = np.full((num_slots, self.max_pages), num_blocks,
                                   np.int32)
+        self._table_device: Optional[jnp.ndarray] = None
         self._free_blocks: List[int] = list(range(num_blocks - 1, -1, -1))
         self._slot_blocks: List[List[int]] = [[] for _ in range(num_slots)]
         self._slot_reserved = [0] * num_slots
+        self._slot_shared = [0] * num_slots
         self._reserved_total = 0
+        self._block_refs = [0] * num_blocks
+        self._shared_count = 0  # blocks at refcount > 1 (O(1) tick gauge)
+        # which slot's reservation covers each live block (the slot that
+        # allocated it); None once that slot released while sharers remain
+        self._block_owner: List[Optional[int]] = [None] * num_blocks
+        self._orphans = 0  # live blocks covered by no reservation
 
     def release(self, slot: int) -> None:
-        """Free the slot AND reclaim its blocks + reservation."""
+        """Free the slot, DECREF its blocks (freeing only those that hit
+        zero — shared blocks survive for their other users) and reclaim its
+        reservation. Blocks this slot allocated but still shared elsewhere
+        become orphans: alive, charged against ``unreserved_blocks``, freed
+        when the last sharer releases."""
         self._release_slot(slot)
-        self._free_blocks.extend(self._slot_blocks[slot])
+        freed = []
+        for block in self._slot_blocks[slot]:
+            if self._block_refs[block] == 2:
+                self._shared_count -= 1  # dropping to a single user
+            self._block_refs[block] -= 1
+            if self._block_refs[block] == 0:
+                if self._block_owner[block] is None:
+                    self._orphans -= 1  # was orphaned; now truly free
+                self._block_owner[block] = None
+                freed.append(block)
+            elif self._block_owner[block] == slot:
+                # sharers outlive the allocator: no reservation covers this
+                # block any more, so count it explicitly
+                self._block_owner[block] = None
+                self._orphans += 1
+        self._free_blocks.extend(freed)
         self._free_blocks.sort(reverse=True)  # deterministic: lowest block next
+        if self.prefix_cache is not None:
+            for block in freed:
+                self.prefix_cache.forget_block(block)
         self._slot_blocks[slot] = []
+        self._slot_shared[slot] = 0
         self.page_table[slot, :] = self.num_blocks
+        self._table_device = None
         self._reserved_total -= self._slot_reserved[slot]
         self._slot_reserved[slot] = 0
 
@@ -181,7 +324,17 @@ class PagedCachePool(_SlotLedger):
 
     @property
     def unreserved_blocks(self) -> int:
-        return self.num_blocks - self._reserved_total
+        """Blocks no reservation OR live orphan is holding — what admission
+        may promise to a new request without ever risking an empty free
+        list mid-stream."""
+        return self.num_blocks - self._reserved_total - self._orphans
+
+    @property
+    def shared_blocks(self) -> int:
+        """Live blocks currently mapped by more than one slot — an O(1)
+        counter maintained at incref/decref (the engine samples this every
+        tick)."""
+        return self._shared_count
 
     @property
     def token_capacity(self) -> int:
@@ -190,41 +343,80 @@ class PagedCachePool(_SlotLedger):
     def blocks_for(self, tokens: int) -> int:
         return -(-int(tokens) // self.page_size)
 
-    def can_reserve(self, tokens: int) -> bool:
-        """Would a request needing ``tokens`` cache positions fit? Checked
-        against RESERVATIONS, not current allocation — an admitted request
-        must never hit an empty free list mid-stream."""
-        need = self.blocks_for(tokens)
-        return need <= self.num_blocks - self._reserved_total and \
-            need <= self.max_pages
+    def can_reserve(self, tokens: int, shared_blocks: int = 0) -> bool:
+        """Would a request needing ``tokens`` cache positions fit, given
+        ``shared_blocks`` of its leading pages already live in the pool?
+        Checked against RESERVATIONS (+ orphaned shared blocks), not current
+        allocation — an admitted request must never hit an empty free list
+        mid-stream. A prefix hit is only charged its UNSHARED tail."""
+        total = self.blocks_for(tokens)
+        need = total - int(shared_blocks)
+        return need <= self.unreserved_blocks and total <= self.max_pages
 
-    def reserve(self, slot: int, tokens: int) -> None:
+    def reserve(self, slot: int, tokens: int, shared_blocks: int = 0) -> None:
         if not self._claimed[slot]:
             raise ValueError(f"slot {slot} is not claimed")
-        if not self.can_reserve(tokens):
+        if not self.can_reserve(tokens, shared_blocks):
             raise ValueError(
-                f"cannot reserve {self.blocks_for(tokens)} blocks "
-                f"({self.unreserved_blocks} unreserved of {self.num_blocks})"
+                f"cannot reserve {self.blocks_for(tokens) - shared_blocks} "
+                f"blocks ({self.unreserved_blocks} unreserved of "
+                f"{self.num_blocks})"
             )
-        self._slot_reserved[slot] = self.blocks_for(tokens)
+        self._slot_reserved[slot] = self.blocks_for(tokens) - int(shared_blocks)
         self._reserved_total += self._slot_reserved[slot]
+
+    def adopt_shared(self, slot: int, blocks: List[int]) -> None:
+        """Map the slot's LEADING page-table entries onto existing blocks
+        (a prefix-cache hit): incref each, no device work, no new memory.
+        Must run before any ``alloc_to`` for the slot — shared pages are by
+        construction the prompt's first pages."""
+        if not self._claimed[slot]:
+            raise ValueError(f"slot {slot} is not claimed")
+        if self._slot_blocks[slot]:
+            raise ValueError(
+                f"slot {slot} already has pages; adopt_shared must precede "
+                "allocation"
+            )
+        for page, block in enumerate(blocks):
+            block = int(block)
+            if not 0 <= block < self.num_blocks or self._block_refs[block] < 1:
+                raise ValueError(f"cannot adopt dead block {block}")
+            if self._block_refs[block] == 1:
+                self._shared_count += 1  # gaining its second user
+            self._block_refs[block] += 1
+            self._slot_blocks[slot].append(block)
+            self.page_table[slot, page] = block
+        self._slot_shared[slot] = len(blocks)
+        if blocks:
+            self._table_device = None
 
     def alloc_to(self, slot: int, tokens: int) -> None:
         """Ensure the slot's pages cover ``tokens`` positions (on-demand
         growth; the engine calls this before each tick with that tick's
-        worst-case end length, clamped to the slot's write limit)."""
+        worst-case end length, clamped to the slot's write limit). Freshly
+        allocated blocks start at refcount 1, owned by this slot."""
         need = min(self.blocks_for(tokens), self.max_pages)
         have = len(self._slot_blocks[slot])
-        if need > self._slot_reserved[slot]:
+        if need - self._slot_shared[slot] > self._slot_reserved[slot]:
             raise ValueError(
-                f"slot {slot} needs {need} blocks but reserved only "
-                f"{self._slot_reserved[slot]} — the write limit should have "
-                "made this unreachable"
+                f"slot {slot} needs {need - self._slot_shared[slot]} private "
+                f"blocks but reserved only {self._slot_reserved[slot]} — the "
+                "write limit should have made this unreachable"
             )
         for page in range(have, need):
             block = self._free_blocks.pop()  # reservation guarantees supply
+            self._block_refs[block] = 1
+            self._block_owner[block] = slot
             self._slot_blocks[slot].append(block)
             self.page_table[slot, page] = block
+        if need > have:
+            self._table_device = None
 
     def page_table_device(self) -> jnp.ndarray:
-        return jnp.asarray(self.page_table)
+        """Device copy of the page table, memoized: re-uploaded only after
+        a mutation (``alloc_to`` growth, ``adopt_shared``, ``release``) —
+        steady-state decode ticks reuse the same device buffer instead of
+        paying a host→device transfer per tick."""
+        if self._table_device is None:
+            self._table_device = jnp.asarray(self.page_table)
+        return self._table_device

@@ -43,7 +43,11 @@ from gradaccum_tpu.models.gpt_decode import (
     sample_token,
 )
 from gradaccum_tpu.resilience import faults
-from gradaccum_tpu.serving.cache_pool import CachePool, PagedCachePool
+from gradaccum_tpu.serving.cache_pool import (
+    CachePool,
+    PagedCachePool,
+    PrefixCache,
+)
 from gradaccum_tpu.serving.metrics import ServingMetrics
 from gradaccum_tpu.serving.scheduler import QueueFull, Request, Scheduler
 from gradaccum_tpu.utils.profiling import StepWindowProfiler
@@ -165,6 +169,38 @@ def _make_paged_admit_fn(cfg: GPTConfig, temperature: float, top_k):
     return jax.jit(admit, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
 
 
+def _make_prefix_admit_fn(cfg: GPTConfig, temperature: float, top_k):
+    """The prefix-sharing twin of :func:`_make_paged_admit_fn`: ``ids`` /
+    ``suffix_lens`` carry only each row's UNSHARED tail, ``start_lens`` the
+    page-aligned shared token counts (0 on a miss — the program is one and
+    the same for hit and miss rows, so the compile count stays bounded by
+    (batch, bucketed-suffix-length, bucketed-prefix-pages) — still a small
+    static set, never traffic), and ``read_tables`` the rows' leading
+    page-table entries for gathering shared K/V. Slot lengths land at the FULL prompt length ``start + suffix``,
+    which is also where decode writes resume — strictly after the shared
+    region."""
+
+    def admit(params, k, v, lengths, cur_tok, gen_count, rngs, limit,
+              ids, suffix_lens, start_lens, slots, keys, page_rows,
+              read_tables, limits):
+        k, v, logits = prefill_paged(params, cfg, ids, suffix_lens, k, v,
+                                     page_rows, start_lens=start_lens,
+                                     read_tables=read_tables)
+
+        def pick(lg, key):
+            return sample_token(lg, key, 0, temperature, top_k)
+
+        tok0 = jax.vmap(pick)(logits, keys).astype(jnp.int32)
+        lengths = lengths.at[slots].set(start_lens + suffix_lens)
+        cur_tok = cur_tok.at[slots].set(tok0)
+        gen_count = gen_count.at[slots].set(1)
+        rngs = rngs.at[slots].set(keys)
+        limit = limit.at[slots].set(limits)
+        return k, v, lengths, cur_tok, gen_count, rngs, limit, tok0
+
+    return jax.jit(admit, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
+
+
 class Engine:
     """Multiplexes concurrent generation requests through one decode tick.
 
@@ -195,6 +231,17 @@ class Engine:
     reserves a request's worst-case pages up front so decoding can never
     run out mid-stream — the engine refuses admission (and tells you it
     was BLOCKS, not slots) instead of preempting.
+
+    ``prefix_cache`` (paged mode only; ``True`` or a
+    :class:`~gradaccum_tpu.serving.cache_pool.PrefixCache`) turns on
+    SHARED-PREFIX admission: page-aligned prompt chunks are hashed at
+    admission, and a request whose leading chunks match live blocks maps
+    its page-table entries to those SAME blocks (refcounted — freed only
+    when the last sharer retires), reserves only its unshared tail, and
+    prefills only the tail at positions past the shared region. Identical
+    system prompts then cost one set of blocks total and a suffix-sized
+    prefill per request; outputs are token-for-token unchanged (the parity
+    gate in tests/test_serving_prefix.py).
     """
 
     def __init__(
@@ -209,6 +256,7 @@ class Engine:
         decode_block_set: Optional[Tuple[int, ...]] = None,
         page_size: Optional[int] = None,
         num_blocks: Optional[int] = None,
+        prefix_cache=None,
         scheduler: Optional[Scheduler] = None,
         metrics: Optional[ServingMetrics] = None,
         min_prefill_bucket: int = 8,
@@ -232,16 +280,37 @@ class Engine:
         self.top_k = None if top_k is None else int(top_k)
         self.paged = page_size is not None
         self.page_size = None if page_size is None else int(page_size)
+        # truthiness is not enough: an EMPTY PrefixCache instance is falsy
+        # (__len__ == 0) but is still an explicit request for sharing
+        wants_prefix = bool(prefix_cache) or isinstance(prefix_cache,
+                                                        PrefixCache)
+        if wants_prefix and not self.paged:
+            raise ValueError("prefix_cache needs paged mode (page_size=...)")
         if self.paged:
+            if isinstance(prefix_cache, PrefixCache):
+                self.prefix_cache: Optional[PrefixCache] = prefix_cache
+            else:
+                self.prefix_cache = (PrefixCache(self.page_size)
+                                     if wants_prefix else None)
             if num_blocks is None:
                 # equal bytes to the fixed pool by default
                 num_blocks = num_slots * max_len // self.page_size
             self.num_blocks = int(num_blocks)
             self.pool = PagedCachePool(cfg, num_slots, max_len,
-                                       self.page_size, self.num_blocks)
+                                       self.page_size, self.num_blocks,
+                                       prefix_cache=self.prefix_cache)
         else:
+            self.prefix_cache = None
             self.num_blocks = None
             self.pool = CachePool(cfg, num_slots, max_len)
+        # prefix matches found by this tick's admission gate, consumed by
+        # _admit (request_id -> shared block ids)
+        self._pending_match: Dict[int, List[int]] = {}
+        # memoized head match for _bottleneck's diagnostic (request_id,
+        # shared blocks) — a rejected submit storm must not re-hash the
+        # stalled head's prompt per rejection; mild staleness is fine, the
+        # value only names the scarce resource in an exception message
+        self._head_match_memo: Optional[Tuple[int, int]] = None
         self.scheduler = scheduler or Scheduler()
         self.metrics = metrics or ServingMetrics()
         self.min_prefill_bucket = min_prefill_bucket
@@ -279,6 +348,16 @@ class Engine:
             b: make_tick(cfg, self.temperature, self.top_k, b)
             for b in self.decode_block_set
         }
+        # prefix engines carry BOTH paged admit programs: the suffix-aware
+        # one for batches with at least one hit, and the plain one so an
+        # all-miss batch (the steady state at low hit rates) never pays the
+        # masked-out prefix gather — program count stays bounded at two
+        # families, still traffic-independent
+        self._prefix_admit_fn = None
+        if self.paged and self.prefix_cache is not None:
+            self._prefix_admit_fn = _make_prefix_admit_fn(
+                cfg, self.temperature, self.top_k
+            )
         if self.paged:
             self._admit_fn = _make_paged_admit_fn(cfg, self.temperature,
                                                   self.top_k)
@@ -311,8 +390,12 @@ class Engine:
 
     def prefill_compile_count(self) -> int:
         """Distinct (batch, bucketed-length) prefill programs — bounded by
-        the bucket set, not by traffic."""
-        return self._admit_fn._cache_size()
+        the bucket set (times two admit families in prefix mode), not by
+        traffic."""
+        count = self._admit_fn._cache_size()
+        if self._prefix_admit_fn is not None:
+            count += self._prefix_admit_fn._cache_size()
+        return count
 
     def manifest(self) -> dict:
         """The engine's static serving shape, for the export manifest
@@ -325,6 +408,7 @@ class Engine:
             "decode_block_set": list(self.decode_block_set),
             "page_size": self.page_size,
             "num_blocks": self.num_blocks,
+            "prefix_cache": self.prefix_cache is not None,
             "temperature": self.temperature,
             "top_k": self.top_k,
             "min_prefill_bucket": self.min_prefill_bucket,
@@ -407,10 +491,21 @@ class Engine:
             return "no free slots"
         if self.paged:
             # judge by what admission would actually ask for: the queue
-            # head's reservation (one page when the queue is empty)
+            # head's reservation — only its UNSHARED blocks when the prefix
+            # cache would cover the rest (one page when the queue is empty)
             head = self.scheduler.peek()
-            need = (self.pool.blocks_for(head.prompt.size + head.max_new_tokens)
-                    if head is not None else 1)
+            if head is not None:
+                need = self.pool.blocks_for(head.prompt.size
+                                            + head.max_new_tokens)
+                if self.prefix_cache is not None:
+                    memo = self._head_match_memo
+                    if memo is None or memo[0] != head.request_id:
+                        memo = (head.request_id,
+                                len(self.prefix_cache.match(head.prompt)))
+                        self._head_match_memo = memo
+                    need -= memo[1]
+            else:
+                need = 1
             if need > self.pool.unreserved_blocks:
                 return "no free KV blocks"
         return "queue backlog (slots available)"
@@ -440,13 +535,20 @@ class Engine:
             # this same admission batch (they only land in the pool inside
             # _admit, after the scheduler pops)
             pending = [0]
+            self._pending_match.clear()
 
             def fits(r):
-                need = self.pool.blocks_for(r.prompt.size + r.max_new_tokens)
+                total = self.pool.blocks_for(r.prompt.size + r.max_new_tokens)
+                shared = (self.prefix_cache.match(r.prompt)
+                          if self.prefix_cache is not None else [])
+                # a prefix hit is charged only its unshared tail — that is
+                # what reserve() will charge, so the gate stays truthful
+                need = total - len(shared)
                 if (pending[0] + need > self.pool.unreserved_blocks
-                        or need > self.pool.max_pages):
+                        or total > self.pool.max_pages):
                     return False
                 pending[0] += need
+                self._pending_match[r.request_id] = shared
                 return True
 
         reqs = self.scheduler.admit(self.pool.free_count, t, fits=fits)
@@ -510,6 +612,8 @@ class Engine:
                                  * self._token_bytes),
                 free_blocks=self.pool.free_blocks,
             )
+            if self.prefix_cache is not None:
+                gauges["shared_blocks"] = self.pool.shared_blocks
         else:
             gauges.update(
                 token_capacity=self.pool.num_slots * self.max_len,
@@ -531,13 +635,33 @@ class Engine:
                 self.status.pop(request_id))
 
     def cancel(self, request_id: int) -> bool:
-        """Cancel a QUEUED request (running ones run to completion). The
-        request's result stays poppable with status "cancelled"; a
-        cancelled request can no longer expire — the scheduler forgot it."""
+        """Cancel a queued OR running request. Queued: the scheduler
+        forgets it (it can no longer expire). Running: the slot is released
+        mid-stream between ticks — on the paged pool its blocks are
+        DECREF'd, so private pages and the reservation come back
+        immediately while prefix blocks other requests share stay alive for
+        them. Either way the partial result stays poppable with status
+        "cancelled". False for unknown / already-finished ids.
+
+        Like every Engine method this is NOT thread-safe: it mutates pool
+        free-lists and page tables, so it must never race a concurrent
+        ``step()``. With a :class:`~gradaccum_tpu.serving.server.
+        ServingServer` attached, call ``server.cancel()`` instead — it
+        holds the engine lock."""
         if self.scheduler.cancel(request_id):
             self.status[request_id] = "cancelled"
             self.metrics.record_finish(request_id, "cancelled")
             return True
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.request_id == request_id:
+                self._active[slot] = False
+                self._slot_req[slot] = None
+                self.pool.release(slot)
+                self._slot_len[slot] = 0
+                self._slot_limit[slot] = 0
+                self.status[request_id] = "cancelled"
+                self.metrics.record_finish(request_id, "cancelled")
+                return True
         return False
 
     def recover(self) -> List[Request]:
@@ -554,6 +678,7 @@ class Engine:
         :class:`~gradaccum_tpu.serving.server.ServingServer`).
         """
         failed = []
+        self._pending_match.clear()
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
@@ -570,8 +695,14 @@ class Engine:
         if any(getattr(a, "is_deleted", lambda: False)() for a in device_arrays):
             num_slots = self.pool.num_slots
             if self.paged:
+                if self.prefix_cache is not None:
+                    # every block of the old pool is gone; releasing the
+                    # slots above already forgot their entries, but clear
+                    # defensively so no stale hash can outlive the rebuild
+                    self.prefix_cache.clear()
                 self.pool = PagedCachePool(self.cfg, num_slots, self.max_len,
-                                           self.page_size, self.num_blocks)
+                                           self.page_size, self.num_blocks,
+                                           prefix_cache=self.prefix_cache)
             else:
                 self.pool = CachePool(self.cfg, num_slots, self.max_len)
             key0 = jax.random.PRNGKey(0)
@@ -613,39 +744,94 @@ class Engine:
         # instead of leaking the slots and stranding the callers
         for slot, req in zip(slots, reqs):
             self._slot_req[slot] = req
-        s0 = self._bucket_len(max(r.prompt.size for r in reqs))
+        prefix = self.paged and self.prefix_cache is not None
+        # prefix hits prefill only their unshared tail, so the ids buffer
+        # (and its bucket) is sized by the longest TAIL, not prompt
+        matches = {r.request_id: self._pending_match.pop(r.request_id, [])
+                   for r in reqs} if prefix else {}
+        shared_tok = {rid: len(blocks) * self.page_size
+                      for rid, blocks in matches.items()}
+        tails = [r.prompt.size - shared_tok.get(r.request_id, 0) for r in reqs]
+        s0 = self._bucket_len(max(tails))
         ids = np.zeros((len(reqs), s0), np.int32)
         lens = np.zeros((len(reqs),), np.int32)
         for i, r in enumerate(reqs):
-            ids[i, s0 - r.prompt.size:] = r.prompt
-            lens[i] = r.prompt.size
+            ids[i, s0 - tails[i]:] = r.prompt[r.prompt.size - tails[i]:]
+            lens[i] = tails[i]
         keys = jnp.stack([jax.random.PRNGKey(r.rng_seed) for r in reqs])
         if self.paged:
-            # reserve the worst case, allocate the prompt's pages now —
-            # decode pages arrive on demand as lengths cross boundaries
+            # adopt shared prefix blocks (incref, page-table writes only),
+            # reserve the unshared worst case, allocate the tail's prompt
+            # pages now — decode pages arrive on demand as lengths cross
+            # boundaries
             page_size = self.page_size
             s0_pages = -(-s0 // page_size)
             page_rows = np.full((len(reqs), s0_pages), self.pool.num_blocks,
                                 np.int32)
+            starts = np.zeros((len(reqs),), np.int32)
+            # the prefix gather's extent tracks the batch's LARGEST shared
+            # region (bucketed to powers of two so the admit program count
+            # stays bounded), not max_len — a short shared prefix must not
+            # pay a max_len-wide gather and attention per layer
+            max_shared = max((len(matches.get(r.request_id, ()))
+                              for r in reqs), default=0)
+            prefix_pages = 1
+            while prefix_pages < max_shared:
+                prefix_pages *= 2
+            prefix_pages = min(prefix_pages, self.pool.max_pages)
+            read_tables = np.full((len(reqs), prefix_pages),
+                                  self.pool.num_blocks, np.int32)
             limits = np.zeros((len(reqs),), np.int32)
             for i, (slot, r) in enumerate(zip(slots, reqs)):
+                shared = matches.get(r.request_id, [])
                 budget = r.prompt.size + r.max_new_tokens
-                self.pool.reserve(slot, budget)
+                self.pool.reserve(slot, budget, shared_blocks=len(shared))
+                if shared:
+                    self.pool.adopt_shared(slot, shared)
                 self.pool.alloc_to(slot, r.prompt.size)
-                n = self.pool.blocks_for(r.prompt.size)
-                page_rows[i, :n] = self.pool.page_table[slot, :n]
+                # write pages: the SUFFIX region only — shared pages are
+                # structurally absent from the scatter index
+                n = self.pool.blocks_for(r.prompt.size) - len(shared)
+                page_rows[i, :n] = self.pool.page_table[
+                    slot, len(shared):len(shared) + n]
+                starts[i] = len(shared) * page_size
+                read_tables[i] = self.pool.page_table[slot, :prefix_pages]
                 limits[i] = budget
                 self._slot_len[slot] = r.prompt.size
                 self._slot_limit[slot] = budget
-            out = self._admit_fn(
+            args = (
                 self.params, self.pool.k, self.pool.v, self.pool.lengths,
                 self._cur_tok, self._gen, self._rngs, self._limit,
                 jnp.asarray(ids), jnp.asarray(lens),
-                jnp.asarray(slots, jnp.int32), keys,
-                jnp.asarray(page_rows), jnp.asarray(limits),
             )
+            if prefix and starts.any():
+                out = self._prefix_admit_fn(
+                    *args, jnp.asarray(starts),
+                    jnp.asarray(slots, jnp.int32), keys,
+                    jnp.asarray(page_rows), jnp.asarray(read_tables),
+                    jnp.asarray(limits),
+                )
+            else:
+                # all-miss batch (or prefix off): the plain paged program —
+                # no point gathering a prefix every row masks out
+                out = self._admit_fn(
+                    *args, jnp.asarray(slots, jnp.int32), keys,
+                    jnp.asarray(page_rows), jnp.asarray(limits),
+                )
             (k, v, lengths, self._cur_tok, self._gen, self._rngs,
              self._limit, tok0) = out
+            if prefix:
+                # index this batch's freshly written full-page chunks for
+                # FUTURE admissions (the entries these requests matched are
+                # already present and are skipped) — only after the
+                # dispatch is enqueued, so a same-batch lookup could never
+                # have pointed at pages this very program writes
+                for slot, r in zip(slots, reqs):
+                    full = r.prompt.size // page_size
+                    self.prefix_cache.insert(
+                        r.prompt, [int(b) for b in
+                                   self.pool.page_table[slot, :full]]
+                    )
         else:
             for slot, r in zip(slots, reqs):
                 self._slot_len[slot] = r.prompt.size
@@ -656,6 +842,16 @@ class Engine:
                 jnp.asarray(slots, jnp.int32), keys,
             )
             k, v, lengths, self._cur_tok, self._gen, self._rngs, tok0 = out
+        for i, r in enumerate(reqs):
+            skipped = shared_tok.get(r.request_id, 0)
+            # hit-rate denominator: only admissions that COULD have hit —
+            # a sub-page prompt has no full chunk to match by construction
+            eligible = prefix and r.prompt.size > self.page_size
+            self.metrics.record_admission(
+                computed_tokens=tails[i], skipped_tokens=skipped,
+                shared_blocks=len(matches.get(r.request_id, ())),
+                prefix_hit=(skipped > 0) if eligible else None,
+            )
         self.pool.set_arrays(k, v, lengths)
         tok0_host = np.asarray(jax.device_get(tok0))
         for slot, req, tok in zip(slots, reqs, tok0_host):

@@ -46,6 +46,15 @@ class ServingMetrics:
         self._kv_per_token = LatencySeries()     # bytes/token, loaded ticks
         self.block_waterline: Optional[int] = None  # min free blocks seen
         self.decode_block_ticks: Dict[int, int] = {}  # chosen block -> ticks
+        # prefill/prefix accounting (cumulative, host ints): what admission
+        # actually computed vs what prefix sharing let it skip
+        self.prefill_tokens_computed = 0
+        self.prefill_tokens_skipped = 0
+        self.blocks_saved = 0        # shared-block adoptions (pages not re-stored)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.shared_blocks = LatencySeries()  # sampled per tick (prefix mode)
+        self.shared_blocks_peak: Optional[int] = None
         self._submit_t: Dict[int, float] = {}
         self._last_token_t: Dict[int, float] = {}
         self.tokens_emitted = 0
@@ -79,6 +88,23 @@ class ServingMetrics:
         self._submit_t.pop(request_id, None)
         self._last_token_t.pop(request_id, None)
 
+    def record_admission(self, computed_tokens: int, skipped_tokens: int = 0,
+                         shared_blocks: int = 0,
+                         prefix_hit: Optional[bool] = None) -> None:
+        """One admitted request's prefill bill: ``computed_tokens`` ran
+        through the model, ``skipped_tokens`` rode on shared prefix blocks
+        (``shared_blocks`` of them, adopted instead of re-stored).
+        ``prefix_hit`` is None when no prefix cache is configured — the
+        hit-rate denominator only counts admissions that COULD have hit."""
+        self.prefill_tokens_computed += int(computed_tokens)
+        self.prefill_tokens_skipped += int(skipped_tokens)
+        self.blocks_saved += int(shared_blocks)
+        if prefix_hit is not None:
+            if prefix_hit:
+                self.prefix_hits += 1
+            else:
+                self.prefix_misses += 1
+
     # -- per-tick gauges --------------------------------------------------
 
     def record_tick(self, queue_depth: int, active_slots: int,
@@ -87,7 +113,8 @@ class ServingMetrics:
                     token_capacity: Optional[int] = None,
                     kv_bytes_in_use: Optional[int] = None,
                     free_blocks: Optional[int] = None,
-                    decode_block: Optional[int] = None) -> None:
+                    decode_block: Optional[int] = None,
+                    shared_blocks: Optional[int] = None) -> None:
         self.ticks += 1
         self.queue_depth.add(queue_depth)
         self.occupancy.add(active_slots / num_slots)
@@ -118,6 +145,12 @@ class ServingMetrics:
                 self.decode_block_ticks.get(decode_block, 0) + 1
             )
             scalars["serving/decode_block"] = float(decode_block)
+        if shared_blocks is not None:
+            self.shared_blocks.add(shared_blocks)
+            if (self.shared_blocks_peak is None
+                    or shared_blocks > self.shared_blocks_peak):
+                self.shared_blocks_peak = shared_blocks
+            scalars["serving/shared_kv_blocks"] = float(shared_blocks)
         if self._writer is not None and self._writer.active:
             self._writer.scalars(scalars, step=self.ticks, subdir=self._subdir)
 
@@ -135,6 +168,12 @@ class ServingMetrics:
         reason to exist)."""
         return self._kv_per_token.summary()["mean"]
 
+    def prefix_hit_rate(self) -> Optional[float]:
+        """Fraction of prefix-eligible admissions that shared at least one
+        block (None until a prefix-cache engine admits something)."""
+        n = self.prefix_hits + self.prefix_misses
+        return self.prefix_hits / n if n else None
+
     def summary(self) -> dict:
         return {
             "ttft": self.ttft.summary(),
@@ -147,6 +186,12 @@ class ServingMetrics:
             "kv_bytes_per_token_in_flight": self.kv_bytes_per_token_in_flight(),
             "block_waterline": self.block_waterline,
             "decode_block_ticks": dict(self.decode_block_ticks),
+            "prefill_tokens_computed": self.prefill_tokens_computed,
+            "prefill_tokens_skipped": self.prefill_tokens_skipped,
+            "prefix_hit_rate": self.prefix_hit_rate(),
+            "blocks_saved": self.blocks_saved,
+            "shared_blocks": self.shared_blocks.summary(),
+            "shared_blocks_peak": self.shared_blocks_peak,
             "tokens_emitted": self.tokens_emitted,
             "tokens_per_second": self.tokens_per_second(),
             "ticks": self.ticks,

@@ -261,7 +261,39 @@ class ServingServer:
                 out["free_kv_blocks"] = pool.free_blocks
                 out["num_kv_blocks"] = pool.num_blocks
                 out["kv_token_capacity"] = pool.token_capacity
+            if engine.prefix_cache is not None:
+                # live sharing state + the cumulative prefill bill the
+                # prefix cache saved — the operator's "is it earning its
+                # keep" view
+                out["prefix"] = {
+                    "indexed_chunks": len(engine.prefix_cache),
+                    "shared_kv_blocks": pool.shared_blocks,
+                    "prefix_hit_rate": engine.metrics.prefix_hit_rate(),
+                    "blocks_saved": engine.metrics.blocks_saved,
+                    "prefill_tokens_skipped":
+                        engine.metrics.prefill_tokens_skipped,
+                }
         return out
+
+    def cancel(self, request_id: int) -> bool:
+        """Thread-safe cancel of a queued or RUNNING request (the engine's
+        ``cancel`` is not safe against the loop thread's concurrent tick —
+        this wrapper holds the engine lock). The request's handle finishes
+        with reason "cancelled", keeping any tokens already streamed.
+        False for unknown / already-finished ids."""
+        with self._lock:
+            ok = self._engine.cancel(request_id)
+            if ok:
+                # the handle owns the (partial) output now
+                self._engine.pop_result(request_id)
+        if not ok:
+            return False
+        with self._hlock:
+            handle = self._handles.pop(request_id, None)
+            self._requeues.pop(request_id, None)
+        if handle is not None:
+            handle._finish("cancelled")
+        return True
 
     def submit(self, prompt, max_new_tokens: int, **kwargs) -> StreamHandle:
         """Thread-safe; raises :class:`QueueFull` under backpressure and

@@ -131,6 +131,38 @@ def test_paged_eos_reclaims_blocks_and_reuses_them(tiny_lm):
     assert engine.pool.allocated_blocks == 0
 
 
+def test_paged_cancel_midstream_reclaims_blocks_and_reservation(tiny_lm):
+    """Mirror of the EOS reclaim gate for Engine.cancel(): cancelling a
+    RUNNING paged request frees its slot, blocks, and reservation
+    immediately, and a queued request blocked on those very blocks is then
+    admitted into the recycled pages and decodes exactly."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, cfg.vocab_size, 6).astype(np.int32)
+    # one slot's worth of blocks: the second request NEEDS the cancelled
+    # one's pages (14-token budget -> 4 pages of 4; the pool holds 4)
+    engine = Engine(params, cfg, num_slots=2, max_len=16, page_size=4,
+                    num_blocks=4)
+    rid = engine.submit(prompt, 8)
+    engine.step()
+    rid2 = engine.submit(prompt, 4)  # queued: blocks, not slots
+    engine.step()
+    assert engine.status[rid2] == "queued"
+    assert engine.pool.allocated_blocks > 0
+    assert engine.cancel(rid) is True
+    assert engine.pool.allocated_blocks == 0
+    assert engine.pool.unreserved_blocks == engine.pool.num_blocks
+    tokens, status = engine.pop_result(rid)
+    assert status == "cancelled" and len(tokens) >= 1  # partial stream kept
+    engine.run_until_idle()
+    want = np.asarray(generate_cached(params, cfg, prompt, 4))[0, 6:]
+    np.testing.assert_array_equal(np.asarray(engine.results[rid2]), want)
+    assert engine.pool.allocated_blocks == 0
+
+
 def test_paged_dynamic_decode_block(tiny_lm):
     """decode_block_set: parity holds across host-side block switching,
     decode programs are bounded by the SET (not 1), and the per-tick
@@ -243,6 +275,29 @@ def test_paged_batch_admission_respects_block_budget(tiny_lm):
     assert len(running) == 2
     engine.run_until_idle()
     assert all(engine.status[r] == "done" for r in rids)
+
+
+def test_paged_batch_admission_admits_exactly_one_not_overcommitted(tiny_lm):
+    """Two SAME-TICK admissions whose combined reservations exceed the
+    unreserved pool must admit exactly one — never both — and the stall is
+    counted as no_free_blocks, so the batched `fits` gate provably counts
+    reservations from earlier requests in its own batch."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=4, max_len=32, page_size=8,
+                    num_blocks=3)
+    # each request reserves 2 blocks (4 prompt + 8 new = 12 -> 2 pages of
+    # 8); the pool holds 3, so the pair over-commits by one block
+    r1 = engine.submit(np.ones(4, np.int32), 8)
+    r2 = engine.submit(np.ones(4, np.int32), 8)
+    engine.step()
+    statuses = sorted([engine.status[r1], engine.status[r2]])
+    assert statuses == ["queued", "running"]
+    assert engine.pool._reserved_total == 2  # exactly one reservation landed
+    assert engine.scheduler.stalls.get("no_free_blocks", 0) == 1
+    engine.run_until_idle()
+    assert engine.status[r1] == "done" and engine.status[r2] == "done"
 
 
 def test_paged_queuefull_names_the_bottleneck(tiny_lm):

@@ -1,0 +1,552 @@
+"""Shared-prefix KV blocks: refcounted sharing, prefix-aware admission.
+
+The load-bearing gate is ON/OFF token parity: a shared-system-prompt
+workload served with the prefix cache enabled — greedy AND sampled,
+including requests that retire mid-stream via EOS or cancel so their
+shared blocks are decref'd (never yanked) and later reused — must be
+token-for-token identical to the same trace with the cache off, and to
+solo ``generate_cached``. Sharing changes admission cost and KV bytes,
+never results.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+pytestmark = [pytest.mark.serving, pytest.mark.paged, pytest.mark.prefix]
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(
+        jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)}
+    )
+    return cfg, bundle, params
+
+
+def _shared_prefix_trace(cfg, n=6, sys_len=9, seed=0, eos_for=(), solo=None):
+    """Staggered arrivals sharing one system prompt: the leader lands a
+    tick before the followers so its pages are indexed when they admit.
+    ``eos_for`` picks requests whose eos_id is taken from their own solo
+    generation so they retire mid-stream."""
+    from gradaccum_tpu.serving.server import TraceItem
+
+    rng = np.random.default_rng(seed)
+    sys_p = rng.integers(0, cfg.vocab_size, sys_len).astype(np.int32)
+    items = []
+    for i in range(n):
+        tail = rng.integers(0, cfg.vocab_size,
+                            int(rng.integers(2, 7))).astype(np.int32)
+        prompt = np.concatenate([sys_p, tail])
+        max_new = int(rng.integers(4, 10))
+        eos = None
+        if i in eos_for and solo is not None:
+            full = np.asarray(solo(prompt, max_new))[0, prompt.size:]
+            k = next((j for j in range(1, len(full))
+                      if full[j] not in full[:j]), None)
+            if k is not None:
+                eos = int(full[k])
+        items.append(TraceItem(
+            arrival_tick=0 if i == 0 else 1 + 2 * i,
+            prompt=prompt, max_new_tokens=max_new, eos_id=eos, rng_seed=i,
+        ))
+    return items
+
+
+# -- the parity gate ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("sampled", [False, True])
+def test_prefix_on_off_token_parity(tiny_lm, sampled):
+    """Same shared-prefix trace (mid-stream EOS retirements included)
+    through a prefix-ON and a prefix-OFF paged engine at equal pool
+    memory: identical per-request streams, and the ON leg actually shared
+    (hits counted, prefill tokens skipped, shared blocks observed)."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+    kw = (dict(temperature=0.8, top_k=5) if sampled else {})
+
+    def solo(prompt, n):
+        return generate_cached(params, cfg, prompt, n)
+
+    trace = _shared_prefix_trace(cfg, n=6, eos_for=(2,), solo=solo)
+
+    def run(prefix):
+        engine = Engine(params, cfg, num_slots=3, max_len=32, page_size=4,
+                        prefix_cache=prefix, **kw)
+        driver = SimulationDriver(engine, seed=0)
+        records = driver.run(trace)
+        assert engine.pool.allocated_blocks == 0
+        assert engine.pool.unreserved_blocks == engine.pool.num_blocks
+        return [rec["tokens"] for rec in records], engine
+
+    off, _ = run(False)
+    on, eng = run(True)
+    assert on == off
+    m = eng.metrics.summary()
+    assert m["prefix_hit_rate"] is not None and m["prefix_hit_rate"] > 0
+    assert m["prefill_tokens_skipped"] > 0
+    assert m["shared_blocks_peak"] > 0
+    assert len(eng.prefix_cache) == 0  # index empties with the pool
+    assert eng.decode_compile_count() == 1
+    # solo ground truth for the greedy leg (OFF is already solo-gated in
+    # test_serving_paged.py, but assert directly for the sampled streams)
+    for item, toks in zip(trace, on):
+        want = generate_cached(
+            params, cfg, item.prompt, item.max_new_tokens,
+            rng=jax.random.PRNGKey(item.rng_seed), **kw,
+        )
+        want = np.asarray(want)[0, item.prompt.size:]
+        if item.eos_id is not None and item.eos_id in want:
+            want = want[:list(want).index(item.eos_id) + 1]
+        np.testing.assert_array_equal(np.asarray(toks), want)
+
+
+def test_prefix_hit_skips_prefill_and_shares_blocks(tiny_lm):
+    """A follower with the leader's system prompt adopts the leader's
+    full-page prefix blocks (no new memory for them) and prefills only its
+    tail — the admission bill says so."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(3)
+    sys_p = rng.integers(0, cfg.vocab_size, 9).astype(np.int32)  # 2 full pages
+    tail = rng.integers(0, cfg.vocab_size, 3).astype(np.int32)
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                    prefix_cache=True)
+    engine.submit(sys_p, 8)
+    engine.step()  # leader admitted, 2 full pages indexed
+    before = engine.pool.allocated_blocks
+    engine.submit(np.concatenate([sys_p, tail]), 8)
+    engine.step()
+    m = engine.metrics.summary()
+    assert engine.metrics.prefix_hits == 1
+    assert m["prefill_tokens_skipped"] == 8       # 2 pages x 4 tokens
+    assert m["blocks_saved"] == 2
+    assert engine.pool.shared_blocks == 2
+    # the follower allocated only its unshared pages: 12-token prompt = 3
+    # pages, 2 of them shared -> 1 new prompt page, plus 1 decode page as
+    # this step's tick crossed the page boundary (an unshared admission
+    # would have added 4)
+    assert engine.pool.allocated_blocks == before + 2
+
+
+def test_prefix_blocks_survive_owner_release_then_reclaim(tiny_lm):
+    """The leader retires while a sharer still decodes: shared blocks go
+    ORPHAN (alive, charged against admission) instead of being freed under
+    the sharer; the last release reclaims everything and empties the
+    index, so a later identical prompt is a clean MISS into recycled
+    blocks with exact output."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(4)
+    sys_p = rng.integers(0, cfg.vocab_size, 8).astype(np.int32)
+    pA = np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, 4).astype(np.int32)])
+    pB = np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, 6).astype(np.int32)])
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                    prefix_cache=True)
+    rA = engine.submit(pA, 4)
+    engine.step()
+    rB = engine.submit(pB, 12)
+    engine.step()
+    assert engine.pool.shared_blocks == 2  # the two full sys_p pages
+    while engine.status[rA] != "done":
+        engine.step()
+    # A (the allocator) is gone; B still maps the shared pages
+    assert engine.pool._orphans == 2
+    assert engine.pool.unreserved_blocks == (
+        engine.pool.num_blocks - engine.pool._reserved_total - 2
+    )
+    engine.run_until_idle()
+    assert engine.pool.allocated_blocks == 0
+    assert engine.pool._orphans == 0
+    assert engine.pool.unreserved_blocks == engine.pool.num_blocks
+    assert len(engine.prefix_cache) == 0
+    for rid, p, n in [(rA, pA, 4), (rB, pB, 12)]:
+        want = np.asarray(generate_cached(params, cfg, p, n))[0, p.size:]
+        np.testing.assert_array_equal(np.asarray(engine.results[rid]), want)
+    # recycled blocks: same prefix again is a miss (no stale index entry)
+    hits_before = engine.metrics.prefix_hits
+    rC = engine.submit(pA, 4)
+    engine.run_until_idle()
+    assert engine.metrics.prefix_hits == hits_before  # miss, not a stale hit
+    want = np.asarray(generate_cached(params, cfg, pA, 4))[0, pA.size:]
+    np.testing.assert_array_equal(np.asarray(engine.results[rC]), want)
+
+
+def test_prefix_cancel_midstream_decrefs_shared_only(tiny_lm):
+    """Cancelling a sharer mid-stream frees its private pages and
+    reservation immediately but only DECREFS the shared prefix — the other
+    request keeps decoding to the exact solo output."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(5)
+    sys_p = rng.integers(0, cfg.vocab_size, 8).astype(np.int32)
+    pA = np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, 4).astype(np.int32)])
+    pB = np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, 5).astype(np.int32)])
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                    prefix_cache=True)
+    rA = engine.submit(pA, 10)
+    engine.step()
+    rB = engine.submit(pB, 10)
+    engine.step()
+    assert engine.pool.shared_blocks == 2
+    allocated_mid = engine.pool.allocated_blocks
+    reserved_mid = engine.pool._reserved_total
+    assert engine.cancel(rB) is True
+    assert engine.status[rB] == "cancelled"
+    assert engine.pool.shared_blocks == 0           # B's extra refs dropped
+    assert engine.pool.allocated_blocks < allocated_mid  # private pages freed
+    assert engine.pool._reserved_total < reserved_mid    # reservation back
+    tokens, status = engine.pop_result(rB)
+    assert status == "cancelled"
+    engine.run_until_idle()
+    want = np.asarray(generate_cached(params, cfg, pA, 10))[0, pA.size:]
+    np.testing.assert_array_equal(np.asarray(engine.results[rA]), want)
+    assert engine.pool.allocated_blocks == 0
+    assert engine.pool.unreserved_blocks == engine.pool.num_blocks
+
+
+def test_prefix_aware_reservation_admits_what_sharing_affords(tiny_lm):
+    """Block math is the admission currency: a follower that only fits
+    because its prefix is shared must be ADMITTED with the cache on and
+    STALLED with it off — same pool size."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(6)
+    sys_p = rng.integers(0, cfg.vocab_size, 8).astype(np.int32)
+    tail = rng.integers(0, cfg.vocab_size, 2).astype(np.int32)
+    # leader: 8 + 6 -> reserves 4 pages of 4. follower: 10 + 6 -> 4 pages
+    # worst case, 2 shared. pool of 6 blocks fits 4 + 2 only WITH sharing.
+    def run(prefix):
+        engine = Engine(params, cfg, num_slots=2, max_len=16, page_size=4,
+                        num_blocks=6, prefix_cache=prefix)
+        engine.submit(sys_p, 6)
+        engine.step()
+        rid = engine.submit(np.concatenate([sys_p, tail]), 6)
+        engine.step()
+        return engine, rid
+
+    eng_off, rid_off = run(False)
+    assert eng_off.status[rid_off] == "queued"
+    assert eng_off.scheduler.stalls.get("no_free_blocks", 0) > 0
+    eng_on, rid_on = run(True)
+    assert eng_on.status[rid_on] == "running"
+    eng_on.run_until_idle()
+    eng_off.run_until_idle()
+    assert eng_on.results[rid_on] == eng_off.results[rid_off]
+
+
+# -- pool + index units -------------------------------------------------------
+
+
+def test_prefix_cache_unit():
+    """Cumulative chunk hashing: match walks until the first miss, is
+    clamped strictly below the prompt length, and forget_block invalidates
+    exactly the freed block's entry."""
+    from gradaccum_tpu.serving import PrefixCache
+
+    pc = PrefixCache(page_size=4)
+    prompt = np.arange(12, dtype=np.int32)
+    pc.insert(prompt, [7, 3, 9])
+    assert len(pc) == 3
+    # full match is clamped: a 12-token prompt may share at most 2 pages
+    assert pc.match(prompt) == [7, 3]
+    # longer prompt with the same leading content shares all three
+    assert pc.match(np.arange(20, dtype=np.int32)) == [7, 3, 9]
+    # diverging second page stops the walk after one chunk
+    other = np.concatenate([np.arange(4), np.full(8, 99)]).astype(np.int32)
+    assert pc.match(other) == [7]
+    # sub-page prompts can never share
+    assert pc.match(np.arange(4, dtype=np.int32)) == []
+    pc.forget_block(3)
+    assert pc.match(np.arange(20, dtype=np.int32)) == [7]
+    # first writer stays canonical on duplicate insert; re-registering the
+    # freed chunk re-links the chain (block 9's entry survived — its
+    # cumulative hash still matches, so the walk continues through it)
+    pc.insert(prompt, [1, 2])
+    assert pc.match(np.arange(20, dtype=np.int32)) == [7, 2, 9]
+    pc.clear()
+    assert len(pc) == 0 and pc.match(prompt) == []
+
+
+def test_pool_refcount_and_shared_reservation_accounting():
+    from gradaccum_tpu.models.gpt import GPTConfig
+    from gradaccum_tpu.serving import PagedCachePool, PrefixCache
+
+    cfg = GPTConfig.tiny_for_tests()
+    pc = PrefixCache(page_size=4)
+    pool = PagedCachePool(cfg, num_slots=3, max_len=16, page_size=4,
+                          num_blocks=8, prefix_cache=pc)
+    a = pool.claim()
+    pool.reserve(a, 12)           # 3 pages, all private
+    pool.alloc_to(a, 12)
+    blocks_a = list(pool._slot_blocks[a])
+    pc.insert(np.arange(12, dtype=np.int32), blocks_a)
+
+    b = pool.claim()
+    shared = blocks_a[:2]
+    # b: 16 tokens = 4 pages, 2 shared -> only 2 private charged
+    assert pool.can_reserve(16, shared_blocks=2)
+    pool.reserve(b, 16, shared_blocks=2)
+    assert pool._reserved_total == 3 + 2
+    pool.adopt_shared(b, shared)
+    assert pool.shared_blocks == 2
+    assert [pool.page_table[b, i] for i in range(2)] == shared
+    with pytest.raises(ValueError, match="must precede"):
+        pool.adopt_shared(b, shared)  # pages already mapped
+    pool.alloc_to(b, 16)
+    assert pool.allocated_blocks == 3 + 2  # shared pages not re-allocated
+
+    # allocator releases first: shared blocks orphan, stay live, still
+    # charged against admission; the index entry survives (block is alive)
+    pool.release(a)
+    assert pool.allocated_blocks == 4      # a's private 3rd page freed
+    assert pool._orphans == 2
+    assert pool.unreserved_blocks == 8 - 2 - 2
+    assert pc.match(np.arange(20, dtype=np.int32)) == shared
+
+    # last sharer releases: orphans freed, index invalidated
+    pool.release(b)
+    assert pool.allocated_blocks == 0 and pool._orphans == 0
+    assert pool.unreserved_blocks == 8
+    assert pc.match(np.arange(20, dtype=np.int32)) == []
+    c = pool.claim()
+    with pytest.raises(ValueError, match="dead block"):
+        pool.adopt_shared(c, shared)
+
+
+def test_page_table_device_memoized(tiny_lm):
+    """Unchanged-table ticks reuse the SAME device buffer; any mutation —
+    growth, adoption, release — invalidates it (the satellite: no
+    host->device upload per tick when nothing moved)."""
+    from gradaccum_tpu.models.gpt import GPTConfig
+    from gradaccum_tpu.serving import Engine, PagedCachePool
+
+    cfg = GPTConfig.tiny_for_tests()
+    pool = PagedCachePool(cfg, num_slots=2, max_len=16, page_size=4,
+                          num_blocks=8)
+    t0 = pool.page_table_device()
+    assert pool.page_table_device() is t0
+    a = pool.claim()
+    pool.reserve(a, 8)
+    pool.alloc_to(a, 8)
+    t1 = pool.page_table_device()
+    assert t1 is not t0
+    pool.alloc_to(a, 8)  # no growth -> no invalidation
+    assert pool.page_table_device() is t1
+    pool.release(a)
+    assert pool.page_table_device() is not t1
+
+    # engine-level: a mid-page decode tick must not re-upload
+    _, _, params = tiny_lm
+    cfg_lm = tiny_lm[0]
+    engine = Engine(params, cfg_lm, num_slots=2, max_len=32, page_size=16)
+    engine.submit(np.ones(3, np.int32), 8)  # 11 tokens: one 16-token page
+    engine.step()
+    mid = engine.pool.page_table_device()
+    engine.step()  # still inside the page: same buffer reused
+    assert engine.pool.page_table_device() is mid
+    engine.run_until_idle()
+
+
+def test_prefix_cache_requires_paged_mode(tiny_lm):
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    with pytest.raises(ValueError, match="needs paged mode"):
+        Engine(params, cfg, num_slots=2, max_len=32, prefix_cache=True)
+
+
+# -- surfaces: manifest, stats, smoke ----------------------------------------
+
+
+def test_prefix_manifest_and_server_stats(tiny_lm):
+    """The operator surfaces: manifest records the knob, stats() exposes
+    live sharing state. Driven tick-by-tick on the engine (deterministic);
+    stats() itself needs no running loop."""
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(7)
+    sys_p = rng.integers(0, cfg.vocab_size, 8).astype(np.int32)
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                    prefix_cache=True)
+    assert engine.manifest()["prefix_cache"] is True
+    engine.submit(sys_p, 12)
+    engine.step()  # leader admitted, pages indexed
+    engine.submit(
+        np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, 3)
+                        .astype(np.int32)]), 4
+    )
+    engine.step()  # follower adopts the two sys_p pages
+    stats = ServingServer(engine).stats()
+    pfx = stats["prefix"]
+    assert pfx["prefix_hit_rate"] == 0.5
+    assert pfx["shared_kv_blocks"] == 2
+    assert pfx["blocks_saved"] == 2
+    assert pfx["prefill_tokens_skipped"] == 8
+    assert pfx["indexed_chunks"] >= 2
+    engine.run_until_idle()
+    # engines without the cache don't grow the key
+    engine2 = Engine(params, cfg, num_slots=2, max_len=32, page_size=4)
+    assert engine2.manifest()["prefix_cache"] is False
+    assert "prefix" not in ServingServer(engine2).stats()
+
+
+def test_server_cancel_midstream_threadsafe(tiny_lm):
+    """ServingServer.cancel: the thread-safe path to mid-stream cancel —
+    holds the engine lock against the loop thread's tick, finishes the
+    handle with "cancelled", and the pool reclaims the blocks."""
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(12)
+    prompt = rng.integers(0, cfg.vocab_size, 8).astype(np.int32)
+    engine = Engine(params, cfg, num_slots=2, max_len=64, page_size=4,
+                    prefix_cache=True)
+    with ServingServer(engine) as srv:
+        handle = srv.submit(prompt, 40)
+        next(iter(handle))  # at least one token: the request is running
+        assert srv.cancel(handle.request_id) is True
+        tokens, reason = handle.result(timeout=60)
+        assert reason == "cancelled" and len(tokens) >= 1
+        assert srv.cancel(handle.request_id) is False  # already gone
+    assert engine.pool.allocated_blocks == 0
+    assert engine.pool.unreserved_blocks == engine.pool.num_blocks
+
+
+# -- resilience interop -------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_prefix_engine_recovers_from_tick_fault(tiny_lm):
+    """A mid-tick crash on a prefix-sharing engine decrefs via the normal
+    release path, the rebuilt pool starts with an EMPTY index (no hash may
+    outlive its blocks), and the replayed requests still produce exact
+    greedy output."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.resilience.faults import (
+        FaultInjector,
+        FaultSchedule,
+        FaultSpec,
+    )
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(8)
+    sys_p = rng.integers(0, cfg.vocab_size, 8).astype(np.int32)
+    pA = np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, 3).astype(np.int32)])
+    pB = np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, 4).astype(np.int32)])
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                    prefix_cache=True)
+    inj = FaultInjector(FaultSchedule([FaultSpec(faults.MID_DECODE_TICK,
+                                                 at=3)]))
+    with faults.installed(inj):
+        with ServingServer(engine, max_requeues=2) as srv:
+            hA = srv.submit(pA, 6)
+            hB = srv.submit(pB, 6)
+            toksA, _ = hA.result(timeout=60)
+            toksB, _ = hB.result(timeout=60)
+    assert inj.fired
+    for toks, p in [(toksA, pA), (toksB, pB)]:
+        want = np.asarray(generate_cached(params, cfg, p, 6))[0, p.size:]
+        np.testing.assert_array_equal(np.asarray(toks), want)
+    assert engine.pool.allocated_blocks == 0
+    assert engine.pool.unreserved_blocks == engine.pool.num_blocks
+    assert len(engine.prefix_cache) == 0
+
+
+# -- tooling: smoke, bench, trend (slow lane) --------------------------------
+
+
+@pytest.mark.slow
+def test_serving_smoke_prefix():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from tools.serving_smoke import main as smoke_main
+
+    assert smoke_main(["--prefix"]) == 0
+
+
+@pytest.mark.slow
+def test_bench_prefix_fast(tmp_path):
+    """The prefix bench end-to-end at --fast shapes: both legs present,
+    the prefill bill and KV-per-token ratio recorded, acceptance passing
+    even tiny, and the compile-once assertion intact."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from examples.bench_serving import main as bench_main
+
+    out = tmp_path / "BENCH_prefix.json"
+    result = bench_main(["--prefix", "--fast", "--out", str(out)])
+    assert out.exists()
+    for leg in (result["off"], result["on"]):
+        assert leg["tokens_per_s"] > 0
+        assert leg["prefill_tokens_computed"] > 0
+        assert leg["decode_programs"] == 1
+    assert result["off"]["kv_pool_bytes"] == result["on"]["kv_pool_bytes"]
+    assert result["on"]["prefix_hit_rate"] > 0
+    assert result["on"]["prefill_tokens_skipped"] > 0
+    assert result["prefill_reduction"] >= 2.0
+    assert result["kv_bytes_per_token_ratio"] <= 0.7
+    assert result["acceptance"]["passed"]
+
+
+def test_bench_trend_gates_acceptance(tmp_path):
+    """bench_trend aggregates every BENCH_*.json acceptance block and
+    fails loudly on any recorded regression."""
+    import json
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from tools.bench_trend import main as trend_main
+
+    (tmp_path / "BENCH_a.json").write_text(json.dumps(
+        {"bench": "a", "acceptance": {"passed": True, "required": "x >= 2"}}
+    ))
+    (tmp_path / "BENCH_b.json").write_text(json.dumps(
+        {"metric": "tokens/s", "value": 1.0}  # no acceptance block: listed only
+    ))
+    assert trend_main(["--dir", str(tmp_path)]) == 0
+    (tmp_path / "BENCH_c.json").write_text(json.dumps(
+        {"bench": "c", "acceptance": {"passed": False, "required": "y"}}
+    ))
+    assert trend_main(["--dir", str(tmp_path)]) == 1
+    # an unreadable artifact gates too: a truncated file must not silently
+    # retire the bar it used to carry
+    (tmp_path / "BENCH_c.json").unlink()
+    (tmp_path / "BENCH_d.json").write_text('{"bench": "d", "acce')
+    assert trend_main(["--dir", str(tmp_path)]) == 1
+
+
+@pytest.mark.slow
+def test_bench_trend_repo_artifacts_all_pass():
+    """The slow-lane trajectory check: every acceptance block recorded in
+    the repo's committed BENCH artifacts must still say passed."""
+    import sys
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(root))
+    from tools.bench_trend import main as trend_main
+
+    assert trend_main(["--dir", str(root)]) == 0

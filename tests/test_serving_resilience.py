@@ -316,8 +316,9 @@ def test_engine_deadline_boundary(tiny_lm):
 
 def test_expire_already_cancelled_request(tiny_lm):
     """Cancelling a queued request removes it from the queue, so a later
-    expiry sweep can never double-report it; cancel of a running or unknown
-    request returns False."""
+    expiry sweep can never double-report it; cancel of an unknown or
+    already-finished request returns False; cancelling a RUNNING request
+    releases its slot mid-stream (keeping the partial result)."""
     from gradaccum_tpu.serving import Engine
 
     cfg, _, params = tiny_lm
@@ -329,7 +330,7 @@ def test_expire_already_cancelled_request(tiny_lm):
     assert engine.cancel(rid) is True
     assert engine.status[rid] == "cancelled"
     assert engine.cancel(rid) is False        # already gone from the queue
-    assert engine.cancel(blocker) is False    # running: not cancellable
+    assert engine.cancel(999) is False        # unknown id
     finished = []
     for _ in range(4):  # run well past the would-be deadline
         finished.extend(engine.step().finished)
@@ -337,5 +338,11 @@ def test_expire_already_cancelled_request(tiny_lm):
     assert engine.status[rid] == "cancelled"
     tokens, status = engine.pop_result(rid)
     assert (tokens, status) == ([], "cancelled")
-    engine.run_until_idle()
-    engine.pop_result(blocker)
+    # mid-stream cancel: the running blocker frees its slot immediately,
+    # keeps its partial stream, and cannot be cancelled twice
+    assert engine.cancel(blocker) is True
+    assert engine.pool.active_count == 0
+    assert engine.cancel(blocker) is False
+    tokens, status = engine.pop_result(blocker)
+    assert status == "cancelled" and len(tokens) >= 1
+    assert engine.idle

@@ -1,0 +1,96 @@
+"""Aggregate every BENCH_*.json acceptance block into one table.
+
+Each perf PR leaves a ``BENCH_*.json`` artifact whose ``acceptance`` block
+records the bar it had to clear and whether it did. This tool is the
+machine-checked perf trajectory: it walks all artifacts, prints one row
+per file, and exits nonzero if ANY recorded ``acceptance.passed`` is false
+— so a regression committed into an artifact fails CI (wired as a
+slow-lane test in tests/test_serving_prefix.py) instead of rotting
+silently. Artifacts without an acceptance block (raw measurement dumps
+like BENCH_TPU.json) are listed for context but never gate; an
+UNREADABLE artifact gates as a failure — a truncated file must not
+silently retire the bar it used to carry.
+
+Usage: python tools/bench_trend.py [--dir DIR] [--json FILE]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def collect(bench_dir: str):
+    """One record per BENCH_*.json: name, headline, acceptance (or None)."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            rows.append({"file": name, "bench": f"<unreadable: {e}>",
+                         "acceptance": {"required": "artifact must parse",
+                                        "passed": False},
+                         "passed": False})
+            continue
+        acceptance = data.get("acceptance")
+        if not isinstance(acceptance, dict):
+            acceptance = None
+        rows.append({
+            "file": name,
+            "bench": data.get("bench") or data.get("metric") or "-",
+            "acceptance": acceptance,
+            "passed": None if acceptance is None
+            else bool(acceptance.get("passed")),
+        })
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir),
+        help="directory holding the BENCH_*.json artifacts (default: repo root)")
+    ap.add_argument("--json", default=None,
+                    help="also write the aggregated table to this file")
+    args = ap.parse_args(argv)
+
+    rows = collect(args.dir)
+    if not rows:
+        print(f"no BENCH_*.json artifacts under {args.dir}")
+        return 1
+
+    wf = max(len(r["file"]) for r in rows)
+    print(f"{'artifact':<{wf}}  {'status':<8}  bench / required bar")
+    failures = 0
+    for r in rows:
+        if r["passed"] is None:
+            status = "-"
+            detail = str(r["bench"])
+        else:
+            status = "PASS" if r["passed"] else "FAIL"
+            required = r["acceptance"].get("required") or \
+                r["acceptance"].get("required_speedup") or ""
+            detail = f"{r['bench']}"
+            if required != "":
+                detail += f" [{required}]"
+            if not r["passed"]:
+                failures += 1
+        print(f"{r['file']:<{wf}}  {status:<8}  {detail}")
+    gated = sum(1 for r in rows if r["passed"] is not None)
+    print(f"\n{len(rows)} artifacts, {gated} with acceptance blocks, "
+          f"{failures} failing")
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"rows": rows, "failures": failures}, f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.json}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

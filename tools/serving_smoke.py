@@ -6,9 +6,13 @@ the continuous-batching engine are token-for-token identical to solo
 threaded server streams and drains cleanly; (4) the export manifest
 round-trips the engine knobs. ``--paged`` runs the same gates through the
 paged KV pool (page tables, block reservations, reclaim-at-idle) instead
-of the fixed-slot pool. Exit code 0 = PASS.
+of the fixed-slot pool; ``--prefix`` additionally turns on shared-prefix
+admission (implies paged) and gates a shared-system-prompt workload:
+followers must HIT the prefix index, skip their shared pages' prefill,
+and still match solo ``generate_cached`` token-for-token, with every
+block and index entry reclaimed at idle. Exit code 0 = PASS.
 
-Usage: python tools/serving_smoke.py [--paged]
+Usage: python tools/serving_smoke.py [--paged] [--prefix]
 """
 
 import argparse
@@ -22,7 +26,11 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--paged", action="store_true",
                     help="run the smoke through the paged KV pool")
+    ap.add_argument("--prefix", action="store_true",
+                    help="paged pool + shared-prefix admission gates")
     args = ap.parse_args(argv)
+    if args.prefix:
+        args.paged = True
 
     import numpy as np
 
@@ -37,7 +45,9 @@ def main(argv=None):
     params = bundle.init(jax.random.PRNGKey(0),
                          {"input_ids": np.zeros((1, 8), np.int32)})
     paged_kw = dict(page_size=4) if args.paged else {}
-    mode = "paged" if args.paged else "fixed"
+    if args.prefix:
+        paged_kw["prefix_cache"] = True
+    mode = "prefix" if args.prefix else ("paged" if args.paged else "fixed")
 
     failures = []
 
@@ -86,6 +96,44 @@ def main(argv=None):
         failures.append(f"manifest knobs wrong: {m}")
     if args.paged and m["page_size"] != 4:
         failures.append(f"manifest paging knobs wrong: {m}")
+    if m["prefix_cache"] != args.prefix:
+        failures.append(f"manifest prefix knob wrong: {m}")
+
+    # 5 (--prefix): shared-system-prompt workload must hit, skip prefill
+    # work, stay token-exact, and reclaim blocks + index at idle
+    if args.prefix:
+        sys_p = rng.integers(0, cfg.vocab_size, 9).astype(np.int32)
+        eng = Engine(params, cfg, num_slots=4, max_len=32, **paged_kw)
+        leader = eng.submit(sys_p, 10)
+        eng.step()  # leader admitted -> its full pages are indexed
+        followers = []
+        for i in range(3):
+            tail = rng.integers(0, cfg.vocab_size, 2 + i).astype(np.int32)
+            followers.append(
+                (eng.submit(np.concatenate([sys_p, tail]), 6, rng_seed=i),
+                 np.concatenate([sys_p, tail]))
+            )
+        eng.run_until_idle()
+        for rid, p in [(leader, sys_p)] + followers:
+            n = 10 if rid == leader else 6
+            want = np.asarray(generate_cached(params, cfg, p, n))[0, p.size:]
+            if not np.array_equal(np.asarray(eng.results[rid]), want):
+                failures.append(f"prefix parity mismatch on request {rid}")
+        pm = eng.metrics.summary()
+        if eng.metrics.prefix_hits != 3:
+            failures.append(f"expected 3 prefix hits, got "
+                            f"{eng.metrics.prefix_hits}")
+        if pm["prefill_tokens_skipped"] < 3 * 8:
+            failures.append(f"prefill_tokens_skipped "
+                            f"{pm['prefill_tokens_skipped']} < 24")
+        if eng.pool.allocated_blocks != 0 or len(eng.prefix_cache) != 0:
+            failures.append(
+                f"prefix reclaim leak: {eng.pool.allocated_blocks} blocks, "
+                f"{len(eng.prefix_cache)} index entries at idle"
+            )
+        print(f"prefix: {eng.metrics.prefix_hits} hits, "
+              f"{pm['prefill_tokens_skipped']} prefill tokens skipped, "
+              f"blocks_saved={pm['blocks_saved']}")
 
     if failures:
         print("FAIL:\n  " + "\n  ".join(failures))
