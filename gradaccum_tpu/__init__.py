@@ -33,6 +33,7 @@ from gradaccum_tpu.ops.accumulation import (
     streaming_step,
 )
 from gradaccum_tpu.ops.adamw import adam, adamw
+from gradaccum_tpu.ops.loss_scale import DynamicLossScale, LossScaleConfig
 from gradaccum_tpu.ops.schedule import warmup_polynomial_decay
 from gradaccum_tpu.data.pipeline import Dataset
 from gradaccum_tpu.estimator.config import EvalSpec, RunConfig, TrainSpec

@@ -30,6 +30,12 @@ class RunConfig:
     # bert_train_flops_per_seq): when set and the device's bf16 peak is
     # known, train logging reports MFU next to examples/sec
     flops_per_example: Optional[float] = None
+    # resilience/preemption.py DrainConsensus: when set, the train loop's
+    # preemption poll becomes a CROSS-HOST agreement — a SIGTERM on any
+    # host drains every host to one common target step, so all hosts land
+    # the same final checkpoint. None keeps the per-process flag (single
+    # host / legacy behavior).
+    drain_consensus: Optional[Any] = None
 
 
 @dataclass

@@ -87,6 +87,11 @@ class ModelBundle(NamedTuple):
     # accumulator carry token-level embedding cotangents instead of a dense
     # [vocab, hidden] gradient per micro-batch
     sparse_embed: Any = None
+    # seq-aware models only: which batch keys carry the token dimension
+    # (sharded over the 'seq' mesh axis). None = the BERT defaults
+    # (parallel.ring_attention.SEQ_BATCH_KEYS); the model owns this because
+    # only it knows its batch layout.
+    seq_keys: Any = None
 
 
 class Estimator:
@@ -223,13 +228,17 @@ class Estimator:
                     "sparse_embed composes with the scan/DP/GSPMD paths, "
                     "not 'seq' axis or pipeline"
                 )
-        if accum.skip_nonfinite and (
-            pipeline is not None or self._sp_active or sparse_embed
-        ):
+        # the guarded accumulator runs on EVERY training path (no-mesh, DP,
+        # GSPMD, seq-axis, pipeline, sparse_embed) — only dynamic loss
+        # scaling is out of scope for the pipeline step, whose PPState
+        # carries no DynamicLossScale
+        acc.validate_config(accum)
+        if accum.loss_scale is not None and pipeline is not None:
             raise ValueError(
-                "skip_nonfinite runs on the streaming/scan no-mesh, DP and "
-                "GSPMD paths; the pipeline / 'seq'-axis / sparse_embed "
-                "steps do not implement the guarded accumulator"
+                "dynamic loss scaling is not implemented for the pipeline "
+                "step (PPState carries no DynamicLossScale); the guard "
+                "itself (skip_nonfinite / normalize_by_good_count) works "
+                "under pipeline"
             )
         self.model = model
         self.optimizer = optimizer
@@ -253,6 +262,13 @@ class Estimator:
         self._finalizer = weakref.finalize(self, _finalize_quietly, self._res)
         self._peak_flops = None  # lazy mesh-wide bf16 peak (see _mfu)
         self.nonfinite_skips = 0  # micro-batches skipped by skip_nonfinite
+        # host-side mirrors of the guard's EventWriter series (tests and
+        # operator tooling read these without a TensorBoard backend)
+        self.loss_scale_series = []  # [(step, scale)] from aux["loss_scale"]
+        self.good_count_series = []  # [(step, good)] from aux["good_count"]
+        # step a multi-host drain consensus stopped this trainer at (None
+        # when no drain happened in the last train() call)
+        self.drained_at_step = None
 
     def _ckpt_save(self, state, step_no):
         """Route through the async writer when configured — training only
@@ -312,8 +328,10 @@ class Estimator:
             return pp_init(stages, self.optimizer,
                            pre_params=pre, post_params=post)
         if self.mode == "scan":
-            return acc.scan_init(params, self.optimizer)
-        return acc.streaming_init(params, self.optimizer)
+            return acc.scan_init(params, self.optimizer,
+                                 loss_scale=self.accum.loss_scale)
+        return acc.streaming_init(params, self.optimizer,
+                                  loss_scale=self.accum.loss_scale)
 
     def _maybe_restore(self, template):
         self._ckpt_sync()
@@ -360,13 +378,18 @@ class Estimator:
                 pre_fn=spec.pre_fn,
                 ctx_keys=tuple(spec.ctx_keys),
                 clip_norm=self.accum.clip_norm,
+                skip_nonfinite=self.accum.skip_nonfinite,
+                normalize_by_good_count=self.accum.normalize_by_good_count,
             )
         elif self._sp_active:
             from gradaccum_tpu.parallel.sp import make_dp_sp_train_step
 
+            sp_kwargs = {}
+            if self.model.seq_keys is not None:
+                sp_kwargs["seq_keys"] = tuple(self.model.seq_keys)
             step = make_dp_sp_train_step(
                 loss_fn, self.optimizer, self.accum, self.mesh,
-                needs_rng=needs_rng,
+                needs_rng=needs_rng, **sp_kwargs,
             )
         elif self.mesh is not None and self.sharding_rules is None and not self.zero1:
             inner_builder = None
@@ -559,7 +582,16 @@ class Estimator:
         last_logged_bucket = step_no // log_every
         loss_rows = []  # (step, device scalar) — fetched lazily at flushes
         skip_rows = []  # device scalars from aux["skipped"] (skip_nonfinite)
+        scale_rows = []  # (step, device scalar) from aux["loss_scale"]
+        good_rows = []  # (step, device scalar) from aux["good_count"]
         self.nonfinite_skips = 0
+        self.drained_at_step = None
+        # multi-host preemption consensus (resilience/preemption.py): when
+        # configured, the drain decision and target step are AGREED across
+        # hosts instead of read from the local SIGTERM flag, so every host
+        # lands the same final checkpoint
+        consensus = self.config.drain_consensus
+        drain_target = None
         micro_size = None
         last_saved = None
 
@@ -587,6 +619,20 @@ class Estimator:
                     self.events.scalar(
                         "nonfinite_skips", self.nonfinite_skips, step_no
                     )
+            if scale_rows:
+                rows = [(s, float(v)) for s, v in jax.device_get(scale_rows)]
+                scale_rows.clear()
+                self.loss_scale_series.extend(rows)
+                if cfg.model_dir:
+                    for s, v in rows:
+                        self.events.scalar("loss_scale", v, s)
+            if good_rows:
+                rows = [(s, int(v)) for s, v in jax.device_get(good_rows)]
+                good_rows.clear()
+                self.good_count_series.extend(rows)
+                if cfg.model_dir:
+                    for s, v in rows:
+                        self.events.scalar("good_count", v, s)
 
         def flush(save_ckpt: bool):
             nonlocal last_saved
@@ -603,16 +649,31 @@ class Estimator:
                 # scan mode consumes whole K-cycles: stop before overshooting
                 if max_steps is not None and step_no + k > max_steps:
                     break
-                if preemption.requested():
+                if drain_target is None:
+                    req = preemption.requested()
+                    if consensus is not None:
+                        # collective: every host calls decide() at the same
+                        # cadence until a drain is agreed — then it latches
+                        # (no host may keep calling after another breaks)
+                        drain, target = consensus.decide(req, step_no)
+                        if drain:
+                            drain_target = max(int(target), step_no)
+                            print(f"[train] drain consensus: common target "
+                                  f"step={drain_target}")
+                    elif req:
+                        drain_target = step_no  # single-host: stop here
+                if drain_target is not None and step_no >= drain_target:
                     # SIGTERM / preemption: break to the normal final-save
                     # path below — it writes a checkpoint at this exact
                     # micro-step and drains the async writer, so the
-                    # resumed job continues bitwise from here. Acknowledge
-                    # ONLY when this call owns the final save; with
-                    # final_save=False the caller (train_and_evaluate)
+                    # resumed job continues bitwise from here (and, under
+                    # consensus, at the SAME step on every host).
+                    # Acknowledge ONLY when this call owns the final save;
+                    # with final_save=False the caller (train_and_evaluate)
                     # still needs to see the flag to save and stop.
                     if final_save:
                         preemption.acknowledge()
+                    self.drained_at_step = step_no
                     print(f"[train] preemption requested; stopping at "
                           f"step={step_no}"
                           + (" after final checkpoint" if final_save else ""))
@@ -627,7 +688,7 @@ class Estimator:
                 # installed): PRE may also poison the batch (nan/inf kinds)
                 # to drive the compiled step's non-finite skip path
                 kind = faults.fire(faults.PRE_TRAIN_STEP, step_no)
-                if kind is not None:
+                if kind in faults.DATA_KINDS:
                     batch = faults.corrupt_batch(batch, kind)
                 # observe pre-dispatch: the window always traces >=1 step
                 profiler.observe(step_no)
@@ -638,6 +699,12 @@ class Estimator:
                     skip_rows.append(aux["skipped"])
                     if len(skip_rows) >= 4096:  # same cap as loss_rows —
                         flush_loss_rows()       # runs without a model_dir too
+                if "loss_scale" in aux:
+                    scale_rows.append((step_no, aux["loss_scale"]))
+                if "good_count" in aux:
+                    good_rows.append((step_no, aux["good_count"]))
+                    if len(good_rows) >= 4096:
+                        flush_loss_rows()
                 if cfg.model_dir:
                     loss_rows.append((step_no, aux["loss"]))
                     if len(loss_rows) >= 4096:  # hard cap for huge log cadences
@@ -841,7 +908,7 @@ class Estimator:
                 final_save=False,  # periodic cadence only; final save below
             )
             done_steps = int(jax.device_get(state.step))
-            if preemption.requested():
+            if preemption.requested() or self.drained_at_step is not None:
                 # the chunked train() left the flag for us (final_save was
                 # False, so no checkpoint landed there): save NOW, drain,
                 # and stop — the grace window is for checkpointing, not

@@ -1,4 +1,4 @@
-from gradaccum_tpu.ops import accumulation, adamw, clipping, schedule
+from gradaccum_tpu.ops import accumulation, adamw, clipping, loss_scale, schedule
 from gradaccum_tpu.ops.accumulation import (
     GradAccumConfig,
     accumulate_scan,
@@ -8,6 +8,7 @@ from gradaccum_tpu.ops.accumulation import (
     streaming_step,
 )
 from gradaccum_tpu.ops.adamw import Optimizer, adam, adamw, sgd
+from gradaccum_tpu.ops.loss_scale import DynamicLossScale, LossScaleConfig
 from gradaccum_tpu.ops.clipping import clip_by_global_norm
 from gradaccum_tpu.ops.flash_attention import flash_attention
 from gradaccum_tpu.ops.schedule import polynomial_decay, warmup_polynomial_decay

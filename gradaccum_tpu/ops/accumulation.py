@@ -47,7 +47,7 @@ micro-batch call — accumulators stay replica-invariant.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +55,13 @@ from jax import lax
 
 from gradaccum_tpu.ops.adamw import Optimizer
 from gradaccum_tpu.ops.clipping import clip_by_global_norm
+from gradaccum_tpu.ops.loss_scale import (
+    DynamicLossScale,
+    LossScaleConfig,
+    init_loss_scale,
+    update_loss_scale,
+)
+from gradaccum_tpu.utils import compat
 from gradaccum_tpu.utils.tree import global_norm, tree_zeros_like
 
 
@@ -81,12 +88,38 @@ class GradAccumConfig(NamedTuple):
     # accumulation window is never corrupted; the denominator stays K (a bad
     # micro-batch conservatively shrinks the update instead of rescaling
     # it). If EVERY micro-batch in the window is bad the optimizer apply is
-    # skipped entirely (params and moments bitwise unchanged). aux gains a
-    # "skipped" count the Estimator surfaces via EventWriter. Off by
-    # default: when all inputs are finite the math (and the compiled HLO's
-    # numerics) match the unguarded path exactly, but the extra isfinite
-    # reductions are not free.
+    # skipped entirely (params and moments bitwise unchanged). aux gains
+    # "skipped" / "good_count" counters the Estimator surfaces via
+    # EventWriter. Off by default: when all inputs are finite the math (and
+    # the compiled HLO's numerics) match the unguarded path exactly, but
+    # the extra isfinite reductions are not free.
     skip_nonfinite: bool = False
+    # Skip-AWARE normalization: divide the accumulated gradient by the
+    # (psum'd) number of GOOD micro-batches instead of K(*N) — a skipped
+    # micro-batch then rescales the update over the survivors instead of
+    # shrinking it. All-bad windows still cond-skip the apply entirely.
+    # Requires skip_nonfinite.
+    normalize_by_good_count: bool = False
+    # Optional ops.loss_scale.LossScaleConfig enabling automatic (dynamic)
+    # loss scaling: the loss is scaled before differentiation, the guard
+    # inspects the SCALED gradients, the unscale folds into the apply-time
+    # denominator (before clip), and the scale halves on a dirty window /
+    # regrows after growth_interval clean ones. The DynamicLossScale state
+    # rides in ScanState/StreamingState.loss_scale (checkpointed).
+    # Requires skip_nonfinite.
+    loss_scale: Optional[LossScaleConfig] = None
+    # Mesh axes that partition ONE example (e.g. 'seq': token shards of the
+    # same sequence). Two consequences the step must honor: (a) the
+    # per-micro-batch gradient is the SUM of the shards' contributions —
+    # modern jax's VMA transpose inserts that psum automatically, old jax
+    # needs it emitted explicitly (utils.compat.psum_unsynced); (b) under
+    # skip_nonfinite the good/bad verdict must AGREE across these shards
+    # (pmin) — a micro-batch that is bad on one shard must be skipped on
+    # all, or the zeroed-grad accumulators would diverge. The data axis is
+    # deliberately NOT in here: data shards hold different examples, and
+    # each shard's slice skips independently (the psum'd good count keeps
+    # the denominator honest).
+    example_axes: Tuple[str, ...] = ()
 
 
 # loss_fn(params, micro_batch) -> scalar loss (mean over the micro batch).
@@ -122,6 +155,52 @@ def _zero_if_bad(grads, good):
     )
 
 
+def validate_config(config: "GradAccumConfig") -> None:
+    """Reject knob combinations the guard cannot honor (fail at build time,
+    not as silently-wrong numerics inside a compiled step)."""
+    if config.normalize_by_good_count and not config.skip_nonfinite:
+        raise ValueError(
+            "normalize_by_good_count divides by the guard's good count; it "
+            "requires skip_nonfinite=True"
+        )
+    if config.loss_scale is not None and not config.skip_nonfinite:
+        raise ValueError(
+            "dynamic loss scaling detects overflow through the non-finite "
+            "guard; it requires skip_nonfinite=True"
+        )
+
+
+def _agree(good, axes: Tuple[str, ...]):
+    """pmin a bool verdict over the axes that partition one example."""
+    for ax in axes:
+        good = lax.pmin(good.astype(jnp.int32), ax) > 0
+    return good
+
+
+def _grad_call(grad_fn, scaled_grad_fn, params, micro_batch, scale):
+    """One micro-batch gradient, optionally through the loss scale.
+
+    Returns ``(raw_loss, check_loss, grads)`` — ``check_loss`` is what the
+    finiteness guard must inspect (the SCALED loss, so an overflow at the
+    current scale is flagged even when the raw loss is representable);
+    ``grads`` are scaled when scaling is on (unscale folds into the
+    apply-time denominator).
+    """
+    if scale is None:
+        loss, grads = grad_fn(params, micro_batch)
+        return loss, loss, grads
+    (scaled_loss, loss), grads = scaled_grad_fn(params, micro_batch, scale)
+    return loss, scaled_loss, grads
+
+
+def _make_scaled_grad_fn(loss_fn: "LossFn"):
+    def scaled(params, micro_batch, scale):
+        loss = loss_fn(params, micro_batch)
+        return loss * scale, loss
+
+    return jax.value_and_grad(scaled, has_aux=True)
+
+
 def _finalize(grads, config: GradAccumConfig, denom):
     """normalize accumulated-grad sum by ``denom`` → optional clip
     (optimization.py:83-84). ``denom`` folds the 1/K normalization together
@@ -144,13 +223,22 @@ class ScanState(NamedTuple):
     params: Any
     opt_state: Any
     step: jnp.ndarray  # micro-batches consumed so far (reference global_step)
+    # ops.loss_scale.DynamicLossScale when GradAccumConfig.loss_scale is
+    # set, else None (an empty pytree node: states built before this field
+    # keep their treedef-compatible shape, and checkpoints only change
+    # schema when scaling is actually on).
+    loss_scale: Any = None
 
 
-def scan_init(params, optimizer: Optimizer) -> ScanState:
+def scan_init(
+    params, optimizer: Optimizer,
+    loss_scale: Optional[LossScaleConfig] = None,
+) -> ScanState:
     return ScanState(
         params=params,
         opt_state=optimizer.init(params),
         step=jnp.zeros((), dtype=jnp.int32),
+        loss_scale=None if loss_scale is None else init_loss_scale(loss_scale),
     )
 
 
@@ -177,8 +265,12 @@ def accumulate_scan(
     reaches ``loss_fn`` with an ``"rng"`` entry. The key rides outside the
     batch so data-parallel wrappers can replicate it instead of sharding it.
     """
+    validate_config(config)
     k = config.num_micro_batches
     grad_fn = jax.value_and_grad(loss_fn)
+    scaled_grad_fn = (
+        _make_scaled_grad_fn(loss_fn) if config.loss_scale is not None else None
+    )
     axis = config.axis_name
 
     def train_step(state: ScanState, super_batch, rng=None):
@@ -188,15 +280,19 @@ def accumulate_scan(
                 f"super_batch leaves must be stacked [K={k}, micro, ...]; got "
                 f"leading dims {sorted(leading)}. Use stack_micro_batches(batch, K)."
             )
+        scale_cfg = config.loss_scale
+        if scale_cfg is not None and state.loss_scale is None:
+            raise ValueError(
+                "GradAccumConfig.loss_scale is set but the state carries no "
+                "DynamicLossScale — build it with scan_init(params, opt, "
+                "loss_scale=config.loss_scale)"
+            )
+        scale = state.loss_scale.scale if scale_cfg is not None else None
 
         # Differentiate w.r.t. axis-VARYING params so per-micro-batch grads
         # stay local to the replica (no auto-psum inside the scan body); one
         # explicit psum below covers the whole accumulated sum.
-        diff_params = (
-            jax.tree.map(lambda p: lax.pcast(p, axis, to="varying"), state.params)
-            if axis is not None
-            else state.params
-        )
+        diff_params = compat.pcast_varying(state.params, axis)
 
         if needs_rng:
             if rng is None:
@@ -212,9 +308,17 @@ def accumulate_scan(
             micro_batch, key = x
             if key is not None:
                 micro_batch = _with_rng(micro_batch, key)
-            loss, grads = grad_fn(diff_params, micro_batch)
+            loss, check_loss, grads = _grad_call(
+                grad_fn, scaled_grad_fn, diff_params, micro_batch, scale
+            )
+            # example axes (seq shards): the micro-batch gradient is the
+            # shards' SUM — auto-inserted by VMA, explicit on old jax
+            grads = compat.psum_unsynced(grads, config.example_axes)
             if skip:
-                good = _all_finite(loss, grads)
+                good = _all_finite(check_loss, grads)
+                # axes that partition ONE example (seq shards) must
+                # agree — bad anywhere means skipped everywhere
+                good = _agree(good, config.example_axes)
                 grads = _zero_if_bad(grads, good)
                 loss = jnp.where(good, loss, 0.0)  # masked out of the mean
                 n_good = n_good + good.astype(jnp.int32)
@@ -226,18 +330,27 @@ def accumulate_scan(
                                            unroll=config.unroll)
         if axis is not None:
             accum = lax.psum(accum, axis)  # the one collective per update
-            denom = k * lax.axis_size(axis)
+            total = k * compat.axis_size(axis)
             if skip:
                 n_good = lax.psum(n_good, axis)
         else:
-            denom = k
+            total = k
+        if skip and config.normalize_by_good_count:
+            # rescale over the survivors instead of shrinking the update
+            # (max(.,1) keeps the all-bad window finite; its apply is
+            # cond-skipped below anyway)
+            denom = jnp.maximum(n_good, 1).astype(jnp.float32)
+        else:
+            # denom stays K(*N): a skipped micro-batch contributes zero, so
+            # the update shrinks instead of rescaling
+            denom = total
+        if scale is not None:
+            denom = denom * scale  # unscale BEFORE clip/apply
         grads, norm = _finalize(accum, config, denom)
         apply_step = state.step + k
         if skip:
-            # denom stays K(*N): a skipped micro-batch contributes zero, so
-            # the update shrinks instead of rescaling — and an all-bad
-            # window must not apply at all (AdamW would still decay and
-            # advance moments on a zero gradient).
+            # an all-bad window must not apply at all (AdamW would still
+            # decay and advance moments on a zero gradient)
             new_params, new_opt_state = lax.cond(
                 n_good > 0,
                 lambda _: optimizer.update(
@@ -250,8 +363,17 @@ def accumulate_scan(
             new_params, new_opt_state = optimizer.update(
                 grads, state.opt_state, state.params, apply_step
             )
+        if scale_cfg is not None:
+            # scale self-adjusts at every window boundary, applied or not:
+            # a dirty window halves, growth_interval clean ones regrow
+            new_ls = update_loss_scale(
+                state.loss_scale, scale_cfg, n_good >= total
+            )
+        else:
+            new_ls = state.loss_scale
         new_state = ScanState(
-            params=new_params, opt_state=new_opt_state, step=apply_step
+            params=new_params, opt_state=new_opt_state, step=apply_step,
+            loss_scale=new_ls,
         )
         if skip:
             # logged loss = mean over USABLE micro-batches, across replicas
@@ -271,7 +393,10 @@ def accumulate_scan(
                 loss = lax.pmean(loss, axis)
         aux = {"loss": loss, "grad_norm": norm, "lr_step": apply_step}
         if skip:
-            aux["skipped"] = jnp.int32(denom) - n_good  # window-global count
+            aux["skipped"] = jnp.int32(total) - n_good  # window-global count
+            aux["good_count"] = n_good
+        if scale_cfg is not None:
+            aux["loss_scale"] = new_ls.scale
         return new_state, aux
 
     return train_step
@@ -302,15 +427,22 @@ class StreamingState(NamedTuple):
     # optimizer apply, not run it on a zero gradient); checkpointed with
     # the rest of the state so the guard survives resume too.
     good_count: jnp.ndarray
+    # ops.loss_scale.DynamicLossScale when GradAccumConfig.loss_scale is
+    # set, else None (empty pytree node — see ScanState.loss_scale).
+    loss_scale: Any = None
 
 
-def streaming_init(params, optimizer: Optimizer) -> StreamingState:
+def streaming_init(
+    params, optimizer: Optimizer,
+    loss_scale: Optional[LossScaleConfig] = None,
+) -> StreamingState:
     return StreamingState(
         params=params,
         opt_state=optimizer.init(params),
         accum_grads=tree_zeros_like(params),
         step=jnp.zeros((), dtype=jnp.int32),
         good_count=jnp.zeros((), dtype=jnp.int32),
+        loss_scale=None if loss_scale is None else init_loss_scale(loss_scale),
     )
 
 
@@ -326,8 +458,12 @@ def streaming_step(
     preserved fine print. ``aux["applied"]`` is 1.0 on apply steps. With
     ``needs_rng=True`` the signature is ``train_step(state, batch, rng)``.
     """
+    validate_config(config)
     k = config.num_micro_batches
     grad_fn = jax.value_and_grad(loss_fn)
+    scaled_grad_fn = (
+        _make_scaled_grad_fn(loss_fn) if config.loss_scale is not None else None
+    )
     # Reference phase: apply when step % K == 0 (optimization.py:91) — includes
     # the step-0 quirk. Quirk-free phase applies once K grads have accumulated.
     phase = 0 if config.first_step_quirk else k - 1
@@ -345,51 +481,83 @@ def streaming_step(
             if rng is None:
                 raise ValueError("needs_rng=True: pass train_step(state, batch, rng)")
             micro_batch = _with_rng(micro_batch, rng)
+        scale_cfg = config.loss_scale
+        if scale_cfg is not None and state.loss_scale is None:
+            raise ValueError(
+                "GradAccumConfig.loss_scale is set but the state carries no "
+                "DynamicLossScale — build it with streaming_init(params, "
+                "opt, loss_scale=config.loss_scale)"
+            )
+        scale = state.loss_scale.scale if scale_cfg is not None else None
         # Under shard_map, state.params are replica-invariant, so VMA
         # auto-psums these grads across the axis: they arrive as the SUM of
         # per-replica local gradients — exactly the reference's
         # aggregation=SUM mirrored accumulators (04:55), and the same cost
         # model (one aggregation per micro-batch assign_add). The 1/N
         # (04:46's loss scaling) folds into the apply-time denominator.
-        loss, grads = grad_fn(state.params, micro_batch)
+        loss, check_loss, grads = _grad_call(
+            grad_fn, scaled_grad_fn, state.params, micro_batch, scale
+        )
+        # modern jax auto-psums these grads (invariant params under
+        # shard_map); old jax leaves them replica-local — emit the sum
+        # explicitly there so both worlds see identical accumulators
+        grads = compat.psum_unsynced(
+            grads, ((axis,) if axis is not None else ()) + config.example_axes
+        )
         skip = config.skip_nonfinite
         if skip:
             # a non-finite micro-batch contributes ZEROS to the persistent
             # accumulators — the window survives; denom stays K so the
-            # eventual update shrinks rather than rescales. Under shard_map
-            # the gradient auto-psum already merged replicas (grads are
-            # axis-invariant), but the LOSS is replica-local — the skip
-            # decision must be made invariant explicitly (pmin: any
-            # replica's non-finite loss skips the micro-batch everywhere)
-            # or the zeroed-grad accumulators would diverge across
-            # replicas.
-            finite_loss = jnp.isfinite(loss)
+            # eventual update shrinks rather than rescales (unless
+            # normalize_by_good_count rescales over the survivors). Under
+            # shard_map the gradient auto-psum already merged replicas
+            # (grads are axis-invariant), but the LOSS is replica-local —
+            # the skip decision must be made invariant explicitly (pmin:
+            # any replica's non-finite loss skips the micro-batch
+            # everywhere) or the zeroed-grad accumulators would diverge
+            # across replicas. With loss scaling the SCALED loss is what
+            # overflow shows up in, so that is what gets checked.
+            finite_loss = jnp.isfinite(check_loss)
             if axis is not None:
                 finite_loss = (
                     lax.pmin(finite_loss.astype(jnp.int32), axis) > 0
                 )
             good = _grads_finite(grads, finite_loss)
+            good = _agree(good, config.example_axes)
             grads = _zero_if_bad(grads, good)
             good_inc = good.astype(jnp.int32)
             # aux loss stays the RAW per-micro-batch value: a NaN row in
             # the log marks the skipped micro-batch. (The scan path's
             # masking applies to window MEANS — at micro-batch granularity
             # a skipped batch has no usable loss to substitute.)
-        apply_denom = k * (lax.axis_size(axis) if axis is not None else 1)
+        n_replicas = compat.axis_size(axis) if axis is not None else 1
 
         def apply_branch(operand):
-            params, opt_state, accum, n_good = operand
+            params, opt_state, accum, n_good, ls = operand
             # (a) re-accumulate the current grad first (optimization.py:81)
             accum = jax.tree.map(jnp.add, accum, grads)
+            window_good = n_good + good_inc if skip else None
+            if skip and config.normalize_by_good_count:
+                # good_count counts window micro-batch CALLS (replica
+                # invariant by the pmin above); each good call contributed
+                # a sum-over-replicas gradient, so ×N stays.
+                denom = (
+                    jnp.maximum(window_good, 1).astype(jnp.float32)
+                    * n_replicas
+                )
+            else:
+                denom = k * n_replicas
+            if scale is not None:
+                denom = denom * scale  # unscale BEFORE clip/apply
             # (b)-(c) normalize, cross-replica mean, clip (optimization.py:83-84)
-            avg, _ = _finalize(accum, config, apply_denom)
+            avg, _ = _finalize(accum, config, denom)
             # (d) apply (optimization.py:85); schedule sees the micro-batch step
             sched_step = state.step + step_offset
             if skip:
                 # an all-bad window must not apply at all (AdamW would
                 # still decay params and advance moments on a zero grad)
                 new_params, new_opt_state = lax.cond(
-                    n_good + good_inc > 0,
+                    window_good > 0,
                     lambda _: optimizer.update(avg, opt_state, params,
                                                sched_step),
                     lambda _: (params, opt_state),
@@ -399,25 +567,29 @@ def streaming_step(
                 new_params, new_opt_state = optimizer.update(
                     avg, opt_state, params, sched_step
                 )
+            if scale_cfg is not None:
+                # window boundary: the scale self-adjusts whether or not
+                # the apply ran (an all-bad window is maximally dirty)
+                ls = update_loss_scale(ls, scale_cfg, window_good >= k)
             # (e) zero the accumulators (optimization.py:87) + the window's
             # good-count
             return (new_params, new_opt_state, tree_zeros_like(accum),
-                    jnp.zeros((), jnp.int32))
+                    jnp.zeros((), jnp.int32), ls)
 
         def accumulate_branch(operand):
-            params, opt_state, accum, n_good = operand
+            params, opt_state, accum, n_good, ls = operand
             accum = jax.tree.map(jnp.add, accum, grads)
             if skip:
                 n_good = n_good + good_inc
-            return params, opt_state, accum, n_good
+            return params, opt_state, accum, n_good, ls
 
         applied = (state.step % k) == phase
-        new_params, new_opt_state, new_accum, new_good = lax.cond(
+        new_params, new_opt_state, new_accum, new_good, new_ls = lax.cond(
             applied,
             apply_branch,
             accumulate_branch,
             (state.params, state.opt_state, state.accum_grads,
-             state.good_count),
+             state.good_count, state.loss_scale),
         )
         # Unconditional micro-batch bump (optimization.py:102-103).
         new_state = StreamingState(
@@ -426,6 +598,7 @@ def streaming_step(
             accum_grads=new_accum,
             step=state.step + 1,
             good_count=new_good,
+            loss_scale=new_ls,
         )
         # aux loss is replica-local on purpose (the gradient auto-psum is the
         # only collective this step emits); the DP wrapper pmeans it for
@@ -436,6 +609,9 @@ def streaming_step(
         }
         if config.skip_nonfinite:
             aux["skipped"] = jnp.int32(1) - good.astype(jnp.int32)
+            aux["good_count"] = good_inc
+        if scale_cfg is not None:
+            aux["loss_scale"] = new_ls.scale
         return new_state, aux
 
     return train_step

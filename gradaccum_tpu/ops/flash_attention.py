@@ -204,10 +204,13 @@ def _union_vma(*operands):
     """Union of the operands' varying-manual-axes: every kernel output
     depends on all of q/k/v/mask, so its vma is their union (stamping from
     q alone would mis-declare outputs replicated when only k/v vary)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()  # pre-VMA jax: no varying axes to carry
     vma = frozenset()
     for o in operands:
         if o is not None:
-            vma = vma | (getattr(jax.typeof(o), "vma", None) or frozenset())
+            vma = vma | (getattr(typeof(o), "vma", None) or frozenset())
     return vma
 
 

@@ -1,0 +1,85 @@
+"""Dynamic (automatic) loss scaling — the bf16/fp16 overflow story.
+
+``GradAccumConfig(skip_nonfinite=True)`` keeps a window alive through a
+non-finite micro-batch, but when the NON-finiteness is *systematic* —
+gradients overflowing a low-precision format because the loss scale is too
+hot — skipping forever just shrinks every update. Dynamic loss scaling
+closes that loop the standard way:
+
+- the loss is multiplied by ``scale`` before differentiation, so small
+  gradients survive the low-precision backward;
+- the finiteness guard inspects the SCALED loss/gradients — an overflow at
+  the current scale marks the micro-batch bad exactly as an injected NaN
+  would;
+- the accumulated gradient is unscaled (divided by ``scale``) together
+  with the 1/K normalization, *before* clip and apply, so the optimizer
+  always sees true-magnitude gradients;
+- after each accumulation window the scale self-adjusts: any bad
+  micro-batch in the window halves it (``backoff_factor``), while
+  ``growth_interval`` consecutive clean windows grow it back
+  (``growth_factor``) — persistent overflow self-heals instead of
+  permanently shrinking updates.
+
+The state is two scalars (:class:`DynamicLossScale`) carried inside
+``ScanState``/``StreamingState`` — ordinary checkpointed leaves, so the
+scale survives crash-resume bitwise like everything else (the paper's
+contract, extended to the guard's own knob).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossScaleConfig(NamedTuple):
+    """Static policy for :class:`DynamicLossScale` (see module docstring).
+
+    Defaults follow the usual mixed-precision recipe; tests shrink
+    ``growth_interval`` so a halve-then-regrow cycle fits in a few windows.
+    """
+
+    init_scale: float = 2.0**15
+    growth_interval: int = 200  # clean windows before growing the scale
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    min_scale: float = 1.0
+    max_scale: float = 2.0**24
+
+
+class DynamicLossScale(NamedTuple):
+    """Carried training state: the live multiplier and the clean-window
+    streak that gates regrowth. Both are ordinary checkpointed leaves."""
+
+    scale: jnp.ndarray  # f32 scalar
+    good_windows: jnp.ndarray  # i32 consecutive clean windows at this scale
+
+
+def init_loss_scale(config: LossScaleConfig) -> DynamicLossScale:
+    return DynamicLossScale(
+        scale=jnp.asarray(config.init_scale, jnp.float32),
+        good_windows=jnp.zeros((), jnp.int32),
+    )
+
+
+def update_loss_scale(
+    state: DynamicLossScale, config: LossScaleConfig, window_clean
+) -> DynamicLossScale:
+    """One window-boundary update (jit-traceable; ``window_clean`` is a
+    traced bool). Clean: bump the streak, grow at ``growth_interval``.
+    Dirty: halve (floored at ``min_scale``) and reset the streak."""
+    streak = state.good_windows + 1
+    grow = streak >= config.growth_interval
+    grown = jnp.minimum(
+        state.scale * config.growth_factor, config.max_scale
+    )
+    clean_scale = jnp.where(grow, grown, state.scale)
+    clean_streak = jnp.where(grow, 0, streak)
+    dirty_scale = jnp.maximum(
+        state.scale * config.backoff_factor, config.min_scale
+    )
+    return DynamicLossScale(
+        scale=jnp.where(window_clean, clean_scale, dirty_scale),
+        good_windows=jnp.where(window_clean, clean_streak, 0),
+    )

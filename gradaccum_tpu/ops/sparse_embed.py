@@ -34,10 +34,16 @@ from jax import lax
 from gradaccum_tpu.ops.accumulation import (
     GradAccumConfig,
     ScanState,
+    _agree,
     _finalize,
+    _grads_finite,
     _with_rng,
+    _zero_if_bad,
+    validate_config,
 )
 from gradaccum_tpu.ops.adamw import Optimizer
+from gradaccum_tpu.ops.loss_scale import update_loss_scale
+from gradaccum_tpu.utils import compat
 from gradaccum_tpu.utils.tree import tree_zeros_like
 
 
@@ -72,10 +78,29 @@ def accumulate_scan_sparse_embed(
 
     Supports ``config.axis_name`` (data parallelism): the one psum at apply
     time covers the scattered table gradient along with everything else.
+
+    Resilience parity with :func:`...accumulation.accumulate_scan`:
+    ``skip_nonfinite`` zero-substitutes a bad micro-batch's gradient AND
+    its row cotangents (the scatter-add then deposits nothing for it),
+    cond-skips the apply on all-bad windows, honors
+    ``normalize_by_good_count``, and runs dynamic loss scaling when
+    ``config.loss_scale`` is set — the token-level accumulator gets the
+    same guarantees as the dense one.
     """
+    validate_config(config)
     k = config.num_micro_batches
     grad_fn = jax.value_and_grad(hooks.loss_with_rows, argnums=(0, 1))
+
+    def _scaled(params, rows, micro_batch, scale):
+        loss = hooks.loss_with_rows(params, rows, micro_batch)
+        return loss * scale, loss
+
+    scaled_grad_fn = (
+        jax.value_and_grad(_scaled, argnums=(0, 1), has_aux=True)
+        if config.loss_scale is not None else None
+    )
     axis = config.axis_name
+    skip = config.skip_nonfinite
 
     def train_step(state: ScanState, super_batch, rng=None):
         leading = {x.shape[0] for x in jax.tree.leaves(super_batch)}
@@ -86,30 +111,57 @@ def accumulate_scan_sparse_embed(
             )
         if rng is None:
             raise ValueError("pass train_step(state, batch, rng)")
+        scale_cfg = config.loss_scale
+        if scale_cfg is not None and state.loss_scale is None:
+            raise ValueError(
+                "GradAccumConfig.loss_scale is set but the state carries no "
+                "DynamicLossScale — build it with scan_init(params, opt, "
+                "loss_scale=config.loss_scale)"
+            )
+        scale = state.loss_scale.scale if scale_cfg is not None else None
 
-        diff_params = (
-            jax.tree.map(lambda p: lax.pcast(p, axis, to="varying"), state.params)
-            if axis is not None
-            else state.params
-        )
+        diff_params = compat.pcast_varying(state.params, axis)
         table = _get_path(diff_params, hooks.table_path)
         xs = (super_batch, jax.random.split(rng, k))
 
-        def body(accum, x):
+        def body(carry, x):
+            accum, n_good = carry
             micro_batch, key = x
             micro_batch = _with_rng(micro_batch, key)
             # gather OUTSIDE the differentiated function: d(loss)/d(table)
             # flows through the rows argument only
             rows = jnp.take(table, micro_batch[hooks.ids_key], axis=0)
-            loss, (g_params, g_rows) = grad_fn(diff_params, rows, micro_batch)
+            if scale is None:
+                loss, (g_params, g_rows) = grad_fn(
+                    diff_params, rows, micro_batch
+                )
+                check_loss = loss
+            else:
+                (check_loss, loss), (g_params, g_rows) = scaled_grad_fn(
+                    diff_params, rows, micro_batch, scale
+                )
+            if skip:
+                # the verdict covers BOTH gradient halves: the in-tree
+                # params and the row cotangents the scatter will deposit
+                good = _grads_finite(
+                    g_params,
+                    _grads_finite(g_rows, jnp.isfinite(check_loss)),
+                )
+                good = _agree(good, config.example_axes)
+                g_params = _zero_if_bad(g_params, good)
+                g_rows = jnp.where(good, g_rows, jnp.zeros_like(g_rows))
+                loss = jnp.where(good, loss, 0.0)  # masked out of the mean
+                n_good = n_good + good.astype(jnp.int32)
             accum = jax.tree.map(jnp.add, accum, g_params)
-            return accum, (loss, g_rows)
+            return (accum, n_good), (loss, g_rows)
 
-        accum0 = tree_zeros_like(diff_params)
-        accum, (losses, rows_ct) = lax.scan(body, accum0, xs, length=k,
-                                            unroll=config.unroll)
+        carry0 = (tree_zeros_like(diff_params), jnp.zeros((), jnp.int32))
+        (accum, n_good), (losses, rows_ct) = lax.scan(
+            body, carry0, xs, length=k, unroll=config.unroll
+        )
         # ONE dense scatter-add for the whole K-cycle: rows_ct is
-        # [K, micro, seq, hidden], ids [K, micro, seq]
+        # [K, micro, seq, hidden], ids [K, micro, seq] — skipped
+        # micro-batches' rows were zeroed above, so they deposit nothing
         ids = super_batch[hooks.ids_key].reshape(-1)
         table_grad = jnp.zeros_like(table).at[ids].add(
             rows_ct.reshape(-1, rows_ct.shape[-1]).astype(table.dtype)
@@ -120,20 +172,62 @@ def accumulate_scan_sparse_embed(
 
         if axis is not None:
             accum = lax.psum(accum, axis)
-            denom = k * lax.axis_size(axis)
+            total = k * compat.axis_size(axis)
+            if skip:
+                n_good = lax.psum(n_good, axis)
         else:
-            denom = k
+            total = k
+        if skip and config.normalize_by_good_count:
+            denom = jnp.maximum(n_good, 1).astype(jnp.float32)
+        else:
+            denom = total
+        if scale is not None:
+            denom = denom * scale  # unscale BEFORE clip/apply
         grads, norm = _finalize(accum, config, denom)
         apply_step = state.step + k
-        new_params, new_opt_state = optimizer.update(
-            grads, state.opt_state, state.params, apply_step
-        )
+        if skip:
+            # all-bad window: params and moments must carry over bitwise
+            new_params, new_opt_state = lax.cond(
+                n_good > 0,
+                lambda _: optimizer.update(
+                    grads, state.opt_state, state.params, apply_step
+                ),
+                lambda _: (state.params, state.opt_state),
+                None,
+            )
+        else:
+            new_params, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params, apply_step
+            )
+        if scale_cfg is not None:
+            new_ls = update_loss_scale(
+                state.loss_scale, scale_cfg, n_good >= total
+            )
+        else:
+            new_ls = state.loss_scale
         new_state = ScanState(
-            params=new_params, opt_state=new_opt_state, step=apply_step
+            params=new_params, opt_state=new_opt_state, step=apply_step,
+            loss_scale=new_ls,
         )
-        loss = jnp.mean(losses)
-        if axis is not None:
-            loss = lax.pmean(loss, axis)
-        return new_state, {"loss": loss, "grad_norm": norm, "lr_step": apply_step}
+        if skip:
+            loss_sum = jnp.sum(losses)
+            if axis is not None:
+                loss_sum = lax.psum(loss_sum, axis)
+            loss = jnp.where(
+                n_good > 0,
+                loss_sum / jnp.maximum(n_good.astype(losses.dtype), 1.0),
+                jnp.nan,
+            )
+        else:
+            loss = jnp.mean(losses)
+            if axis is not None:
+                loss = lax.pmean(loss, axis)
+        aux = {"loss": loss, "grad_norm": norm, "lr_step": apply_step}
+        if skip:
+            aux["skipped"] = jnp.int32(total) - n_good
+            aux["good_count"] = n_good
+        if scale_cfg is not None:
+            aux["loss_scale"] = new_ls.scale
+        return new_state, aux
 
     return train_step

@@ -31,6 +31,7 @@ from gradaccum_tpu.ops import accumulation as acc
 from gradaccum_tpu.ops.adamw import Optimizer
 from gradaccum_tpu.parallel.mesh import DATA_AXIS
 from gradaccum_tpu.parallel.sharding import batch_sharding, replicated
+from gradaccum_tpu.utils import compat
 
 
 def make_dp_train_step(
@@ -81,7 +82,7 @@ def make_dp_train_step(
         raise ValueError(f"mode must be 'scan' or 'streaming', got {mode!r}")
 
     in_specs = (P(), batch_spec) + ((P(),) if needs_rng else ())
-    sharded = jax.shard_map(
+    sharded = compat.shard_map(
         step,
         mesh=mesh,
         in_specs=in_specs,

@@ -45,8 +45,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from gradaccum_tpu.ops.accumulation import _grads_finite
 from gradaccum_tpu.ops.adamw import Optimizer
 from gradaccum_tpu.parallel.mesh import PIPE_AXIS
+from gradaccum_tpu.utils import compat
 
 # stage_fn(stage_params, x) -> y, same shape (homogeneous pipeline stages)
 StageFn = Callable[[Any, jnp.ndarray], jnp.ndarray]
@@ -107,13 +109,41 @@ def pp_init(
     )
 
 
+def _micro_batch_guard(batch, k: int):
+    """Per-micro-batch finiteness verdict over a ``[K, ...]``-stacked dict
+    batch, plus the zero-substituted copy.
+
+    Returns ``(good [K] int32, clean_batch)``: float leaves with any
+    non-finite value in micro-batch ``j`` flag it bad and are replaced by
+    zeros for that ``j`` — so ``pre_fn``/the stages compute on finite
+    inputs and their backward stays clean (a NaN forward value would
+    poison cotangents even under a zero incoming cotangent, 0×NaN). Int
+    leaves (token ids, labels) pass through untouched."""
+    good = jnp.ones((k,), jnp.int32)
+    clean = {}
+    for name, leaf in batch.items():
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            ok = jnp.all(
+                jnp.isfinite(leaf), axis=tuple(range(1, leaf.ndim))
+            )
+            good = jnp.minimum(good, ok.astype(jnp.int32))
+            clean[name] = jnp.where(
+                ok.reshape((k,) + (1,) * (leaf.ndim - 1)),
+                leaf, jnp.zeros_like(leaf),
+            )
+        else:
+            clean[name] = leaf
+    return good, clean
+
+
 def pipeline_apply(
     stage_fn: StageFn,
     local_params: Any,
     micro_inputs: jnp.ndarray,
     axis: str = PIPE_AXIS,
     micro_ctx: Any = None,
-) -> jnp.ndarray:
+    guard: bool = False,
+):
     """Run the skewed GPipe schedule. Must run inside ``shard_map``.
 
     ``micro_inputs``: ``[K, B, ...]`` (replicated across the pipe axis);
@@ -126,22 +156,39 @@ def pipeline_apply(
     ``t - r``, so each rank dynamic-slices that entry and ``stage_fn`` is
     called as ``stage_fn(params, x, ctx)`` (bubble ticks clamp the index;
     their outputs are discarded).
+
+    ``guard=True`` (the resilience layer's per-STAGE finiteness check)
+    additionally inspects each tick's incoming activation before the stage
+    consumes it: a non-finite ``x`` is zero-substituted (the ``where``
+    also zeroes its backward cotangent, so the skip never lets NaN reach
+    this stage's gradients) and the micro-batch it belongs to is flagged.
+    Returns ``(outs, good)`` with ``good`` an ``[K]`` int32 vector of THIS
+    rank's verdicts — callers pmin it across the pipe (and data) so every
+    shard skips the same micro-batches.
     """
-    n = lax.axis_size(axis)
+    n = compat.axis_size(axis)
     idx = lax.axis_index(axis)
     k = micro_inputs.shape[0]
     ticks = k + n - 1
     perm = [(i, i + 1) for i in range(n - 1)]
 
+    good = jnp.ones((k,), jnp.int32)
     buf = jnp.zeros_like(micro_inputs[0])
     outs = jnp.zeros_like(micro_inputs)
     for t in range(ticks):  # static unroll: T is small (K + P - 1)
         feed = micro_inputs[t] if t < k else jnp.zeros_like(buf)
         x = jnp.where(idx == 0, feed, buf)
+        j = jnp.clip(t - idx, 0, k - 1)
+        if guard:
+            # at tick t this rank holds micro-batch t - idx; bubble ticks
+            # (outside [0, K)) carry zeros-derived values and are ignored
+            ok = jnp.all(jnp.isfinite(x)).astype(jnp.int32)
+            x = jnp.where(ok > 0, x, jnp.zeros_like(x))
+            valid = (t >= idx) & (t - idx <= k - 1)
+            good = good.at[j].min(jnp.where(valid, ok, 1))
         if micro_ctx is None:
             y = stage_fn(local_params, x)
         else:
-            j = jnp.clip(t - idx, 0, k - 1)
             ctx = jax.tree.map(
                 lambda l: lax.dynamic_index_in_dim(l, j, 0, keepdims=False),
                 micro_ctx,
@@ -153,6 +200,8 @@ def pipeline_apply(
             )
         if n > 1:
             buf = lax.ppermute(y, axis, perm)
+    if guard:
+        return outs, good
     return outs
 
 
@@ -168,6 +217,8 @@ def make_pp_train_step(
     pre_fn=None,
     ctx_keys=(),
     clip_norm: float | None = None,
+    skip_nonfinite: bool = False,
+    normalize_by_good_count: bool = False,
 ):
     """Build ``train_step(state, batch) -> (state, aux)``.
 
@@ -200,11 +251,32 @@ def make_pp_train_step(
     update — the BERT flavor's clip-after-average (optimization.py:83-85)
     under PP. The squared norm sums each rank's local stage slice, psums
     over ``pipe``, and adds the pipe-replicated pre/post contribution once.
+
+    ``skip_nonfinite`` (the resilience layer's in-graph guard, PP flavor):
+    micro-batches are checked at THREE levels, and the verdicts pmin over
+    ``pipe`` (and ``data``) so every shard skips the same micro-batches —
+    (1) raw batch leaves are checked/zero-substituted per micro-batch
+    before ``pre_fn`` (a poisoned host batch never reaches any stage's
+    forward OR backward); (2) every pipeline tick checks the activation a
+    stage is about to consume (:func:`pipeline_apply` ``guard=True``), so
+    an overflow inside stage ``s`` flags the micro-batch at stage ``s+1``;
+    (3) per-micro losses are checked on the last rank. Flagged
+    micro-batches are masked out of the loss mean, so their gradient
+    contribution is exactly zero; ``normalize_by_good_count`` divides by
+    the survivors instead of K. A final net checks the assembled stage
+    gradients themselves (in-stage overflow can still pollute that stage's
+    backward) and cond-skips the whole apply — params and moments carry
+    over bitwise, mirroring the scan path's all-bad-window contract.
     """
     k = num_micro_batches
+    skip = skip_nonfinite
+    if normalize_by_good_count and not skip:
+        raise ValueError(
+            "normalize_by_good_count requires skip_nonfinite=True"
+        )
 
     def step(state: PPState, batch):
-        n = lax.axis_size(axis)
+        n = compat.axis_size(axis)
         idx = lax.axis_index(axis)
         has_prepost = isinstance(state.params, PipelineParams)
         stages = state.params.stages if has_prepost else state.params
@@ -214,16 +286,36 @@ def make_pp_train_step(
             local_stages,
             state.params.post if has_prepost else None,
         )
+        if skip:
+            # (1) the batch guard runs OUTSIDE the differentiated function
+            # (batches carry no gradient): bad micro-batches are zeroed so
+            # pre_fn/stages compute finite values and clean cotangents
+            good_in, batch_c = _micro_batch_guard(batch, k)
+        else:
+            good_in, batch_c = None, batch
 
         def fwd(diff):
             pre, local_params, post = diff
             if pre_fn is not None:
-                micro_inputs = jax.vmap(lambda mb: pre_fn(pre, mb))(batch)
+                micro_inputs = jax.vmap(lambda mb: pre_fn(pre, mb))(batch_c)
             else:
-                micro_inputs = batch[input_key]
-            ctx = {key: batch[key] for key in ctx_keys} if ctx_keys else None
-            outs = pipeline_apply(stage_fn, local_params, micro_inputs, axis, ctx)
-            labels = {key: v for key, v in batch.items() if key != input_key}
+                micro_inputs = batch_c[input_key]
+            ctx = (
+                {key: batch_c[key] for key in ctx_keys} if ctx_keys else None
+            )
+            if skip:
+                # (2) per-stage activation checks ride the schedule
+                outs, stage_good = pipeline_apply(
+                    stage_fn, local_params, micro_inputs, axis, ctx,
+                    guard=True,
+                )
+            else:
+                outs = pipeline_apply(
+                    stage_fn, local_params, micro_inputs, axis, ctx
+                )
+            labels = {
+                key: v for key, v in batch_c.items() if key != input_key
+            }
             if has_prepost:
                 losses = jax.vmap(
                     lambda out, lbl: loss_fn(post, out, lbl)
@@ -232,18 +324,73 @@ def make_pp_train_step(
                 losses = jax.vmap(
                     lambda out, lbl: loss_fn(out, lbl)
                 )(outs, labels)
-            local = jnp.mean(losses)
+            aux = {}
+            if skip:
+                # (3) loss check is meaningful on the last rank only (the
+                # others ran on zeros); everyone else votes 1 so the pmin
+                # broadcasts the last rank's verdict
+                loss_ok = jnp.where(
+                    idx == n - 1,
+                    jnp.isfinite(losses).astype(jnp.int32),
+                    jnp.ones((k,), jnp.int32),
+                )
+                g = jnp.minimum(jnp.minimum(stage_good, loss_ok), good_in)
+                # ALL shards must agree: a micro-batch bad on one pipe
+                # stage or data shard is skipped everywhere
+                g = lax.pmin(g, axis)
+                if data_axis is not None:
+                    g = lax.pmin(g, data_axis)
+                n_good = jnp.sum(g)
+                losses = jnp.where(g > 0, losses, 0.0)
+                if normalize_by_good_count:
+                    denom = jnp.maximum(n_good, 1).astype(losses.dtype)
+                else:
+                    denom = k
+                local = jnp.sum(losses) / denom
+                loss_sum = lax.psum(
+                    jnp.where(idx == n - 1, jnp.sum(losses), 0.0), axis
+                )
+                if data_axis is not None:
+                    loss_sum = lax.pmean(loss_sum, data_axis)
+                aux = {"n_good": n_good, "loss_sum": loss_sum}
+            else:
+                local = jnp.mean(losses)
             # only the last rank saw real outputs; broadcast its loss
             pipe_loss = lax.psum(jnp.where(idx == n - 1, local, 0.0), axis)
             if data_axis is None:
-                return pipe_loss
+                return pipe_loss, aux
             # global-mean loss INSIDE the differentiated function: autodiff's
             # transpose then yields the cross-replica mean gradient directly
             # (shard_map's vma-aware transpose already psums cotangents onto
             # data-replicated params — a post-hoc pmean would double-count)
-            return lax.pmean(pipe_loss, data_axis)
+            return lax.pmean(pipe_loss, data_axis), aux
 
-        loss, (g_pre, g_stages, g_post) = jax.value_and_grad(fwd)(diff_args)
+        (loss, fwd_aux), (g_pre, g_stages, g_post) = jax.value_and_grad(
+            fwd, has_aux=True
+        )(diff_args)
+        if not compat.HAS_VMA:
+            # pre-VMA shard_map (old jax, check_rep=False) transposes the
+            # loss-broadcast psum over 'pipe' back into a psum, so every
+            # cotangent arrives n× the true one — undo that factor, then
+            # emit the collectives the VMA transpose would have inserted:
+            # pre/post gradients sum over 'pipe' (each rank differentiated
+            # only its own contribution), and everything means over 'data'
+            # (the pmean in fwd transposes to cotangent 1 there, leaving
+            # per-rank local gradients). Verified against the sequential
+            # reference in tests/test_pp.py; no-op on modern jax.
+            inv = 1.0 / n
+            rescale = lambda t: jax.tree.map(lambda g: g * inv, t)
+            g_pre, g_stages, g_post = (
+                rescale(g_pre), rescale(g_stages), rescale(g_post),
+            )
+            if g_pre is not None:
+                g_pre = lax.psum(g_pre, axis)
+            if g_post is not None:
+                g_post = lax.psum(g_post, axis)
+            if data_axis is not None:
+                g_pre, g_stages, g_post = lax.pmean(
+                    (g_pre, g_stages, g_post), data_axis
+                )
         if clip_norm is not None:
             sq = lambda tree: sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -265,13 +412,42 @@ def make_pp_train_step(
             PipelineParams(g_pre, g_stages, g_post) if has_prepost else g_stages
         )
         apply_step = state.step + k
-        new_params, new_opt_state = optimizer.update(
-            grads, state.opt_state, state.params, apply_step
-        )
-        return (
-            PPState(new_params, new_opt_state, apply_step),
-            {"loss": loss},
-        )
+        if skip:
+            # final net: in-stage overflow can pollute that stage's
+            # backward even with the loss masked (0×NaN); a window whose
+            # assembled gradients are not finite EVERYWHERE must not apply
+            ok = _grads_finite(grads, jnp.bool_(True)).astype(jnp.int32)
+            ok = lax.pmin(ok, axis)
+            if data_axis is not None:
+                ok = lax.pmin(ok, data_axis)
+            n_good = jnp.where(ok > 0, fwd_aux["n_good"], 0)
+            new_params, new_opt_state = lax.cond(
+                n_good > 0,
+                lambda _: optimizer.update(
+                    grads, state.opt_state, state.params, apply_step
+                ),
+                lambda _: (state.params, state.opt_state),
+                None,
+            )
+            # logged loss = mean over USABLE micro-batches (NaN only when
+            # the whole window was skipped — the log should show it)
+            loss = jnp.where(
+                n_good > 0,
+                fwd_aux["loss_sum"]
+                / jnp.maximum(n_good.astype(loss.dtype), 1.0),
+                jnp.nan,
+            )
+            aux = {
+                "loss": loss,
+                "skipped": jnp.int32(k) - n_good,
+                "good_count": n_good,
+            }
+        else:
+            new_params, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params, apply_step
+            )
+            aux = {"loss": loss}
+        return (PPState(new_params, new_opt_state, apply_step), aux)
 
     n_stages = dict(mesh.shape)[axis]
 
@@ -348,7 +524,7 @@ def make_pp_train_step(
         if key not in jitted:
             in_specs = (state_specs(state), jax.tree.map(batch_leaf_spec, batch))
             jitted[key] = jax.jit(
-                jax.shard_map(
+                compat.shard_map(
                     step, mesh=mesh, in_specs=in_specs,
                     out_specs=(state_specs(state), P()),
                 ),

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from gradaccum_tpu.parallel.mesh import SEQ_AXIS
+from gradaccum_tpu.utils import compat
 
 _NEG_INF = -1e30  # finite stand-in for -inf: keeps exp/corrections NaN-free
 
@@ -157,7 +158,7 @@ def ring_attention(q, k, v, mask=None, dropout_fn=None, *, axis: str = SEQ_AXIS)
     sequence-sharded. No materialized [S,S] anywhere, no all-gather.
     """
     _check_no_dropout(dropout_fn, "ring_attention")
-    n = lax.axis_size(axis)
+    n = compat.axis_size(axis)
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(q.dtype)
     perm = [(i, (i + 1) % n) for i in range(n)]

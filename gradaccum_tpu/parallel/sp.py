@@ -35,6 +35,7 @@ from gradaccum_tpu.ops import accumulation as acc
 from gradaccum_tpu.ops.adamw import Optimizer
 from gradaccum_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 from gradaccum_tpu.parallel.ring_attention import SEQ_BATCH_KEYS as DEFAULT_SEQ_KEYS
+from gradaccum_tpu.utils import compat
 
 
 def make_dp_sp_train_step(
@@ -53,8 +54,19 @@ def make_dp_sp_train_step(
     super-batches stacked ``[K, B, ...]``; leaves named in ``seq_keys``
     are ``[K, B, S]`` and get their token dim sharded over ``seq_axis``,
     everything else shards batch-wise over ``data_axis`` only.
+
+    ``config.skip_nonfinite`` (and with it ``normalize_by_good_count`` /
+    ``loss_scale``) is fully supported: ``seq_axis`` is registered as an
+    example axis, so the per-micro-batch good/bad verdict is pmin-agreed
+    across the token shards — a micro-batch that overflows on ONE seq rank
+    is zero-substituted on ALL of them (anything less would diverge the
+    accumulators) — while the ``data`` shards keep their independent
+    verdicts and the psum'd good count keeps the denominator honest.
     """
-    config = config._replace(axis_name=data_axis)
+    config = config._replace(
+        axis_name=data_axis,
+        example_axes=tuple(config.example_axes) + (seq_axis,),
+    )
     inner = acc.accumulate_scan(loss_fn, optimizer, config, needs_rng=needs_rng)
 
     def batch_specs(batch):
@@ -73,7 +85,7 @@ def make_dp_sp_train_step(
         if key_set not in jitted:
             in_specs = (P(), batch_specs(super_batch)) + ((P(),) if rng else ())
             jitted[key_set] = jax.jit(
-                jax.shard_map(
+                compat.shard_map(
                     inner, mesh=mesh, in_specs=in_specs, out_specs=(P(), P())
                 ),
                 donate_argnums=0,

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from gradaccum_tpu.parallel.mesh import SEQ_AXIS
+from gradaccum_tpu.utils import compat
 
 
 def ulysses_attention(q, k, v, mask=None, dropout_fn=None, *, axis: str = SEQ_AXIS):
@@ -55,7 +56,7 @@ def ulysses_attention(q, k, v, mask=None, dropout_fn=None, *, axis: str = SEQ_AX
     # estimator -> parallel.dp would otherwise re-enter the package init
     from gradaccum_tpu.models.bert import dense_attention
 
-    n = lax.axis_size(axis)
+    n = compat.axis_size(axis)
     heads = q.shape[1]
     if heads % n != 0:
         raise ValueError(

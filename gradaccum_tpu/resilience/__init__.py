@@ -32,7 +32,11 @@ from gradaccum_tpu.resilience.faults import (
     InjectedCrash,
     InjectedIOError,
 )
-from gradaccum_tpu.resilience.preemption import PreemptionHandler
+from gradaccum_tpu.resilience.preemption import (
+    DrainConsensus,
+    LocalDrainBus,
+    PreemptionHandler,
+)
 from gradaccum_tpu.resilience.retry import retry_io
 from gradaccum_tpu.resilience.watchdog import Watchdog
 
@@ -41,11 +45,13 @@ __all__ = [
     "manifest",
     "preemption",
     "retry",
+    "DrainConsensus",
     "FaultInjector",
     "FaultSchedule",
     "FaultSpec",
     "InjectedCrash",
     "InjectedIOError",
+    "LocalDrainBus",
     "PreemptionHandler",
     "retry_io",
     "Watchdog",

@@ -25,7 +25,13 @@ Kinds: ``crash`` raises :class:`InjectedCrash` (simulated process death —
 deliberately NOT an OSError, so IO retry loops never swallow it);
 ``io_error`` raises :class:`InjectedIOError` (an OSError, so retry paths
 treat it as a real transient failure); ``nan``/``inf`` return the kind
-string for the call site to apply via :func:`corrupt_batch`.
+string for the call site to apply via :func:`corrupt_batch`;
+``overflow_storm`` is a BURST of consecutive Inf micro-batches (``span``
+successive indices from ``at``) — the systematic-overflow scenario that
+exercises dynamic loss-scale halving and all-bad windows, seeded via
+:meth:`FaultSchedule.overflow_storm`; ``slow_tick`` sleeps ``delay``
+seconds at the fault point (a wedged-but-not-dead dispatch — what the
+serving watchdog exists to break) and then lets the call proceed.
 
 When no injector is installed every hook is one global load + compare —
 nothing here touches the hot path in production.
@@ -36,6 +42,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -50,7 +57,12 @@ KIND_CRASH = "crash"
 KIND_IO_ERROR = "io_error"
 KIND_NAN = "nan"
 KIND_INF = "inf"
-KINDS = (KIND_CRASH, KIND_IO_ERROR, KIND_NAN, KIND_INF)
+KIND_OVERFLOW_STORM = "overflow_storm"
+KIND_SLOW_TICK = "slow_tick"
+KINDS = (KIND_CRASH, KIND_IO_ERROR, KIND_NAN, KIND_INF,
+         KIND_OVERFLOW_STORM, KIND_SLOW_TICK)
+# kinds whose firing corrupts the caller's data via corrupt_batch
+DATA_KINDS = (KIND_NAN, KIND_INF, KIND_OVERFLOW_STORM)
 
 
 class InjectedCrash(RuntimeError):
@@ -78,21 +90,35 @@ class FaultSpec:
     ``at=None`` matches ANY index (e.g. "every decode tick"). ``count`` is
     how many firings this spec is good for — an ``io_error`` with
     ``count=2`` fails the first two attempts and lets the third retry
-    succeed.
+    succeed. ``span`` widens the match to the ``span`` consecutive indices
+    ``[at, at + span)`` — the burst shape of ``overflow_storm`` (its count
+    defaults to its span so the whole burst fires). ``delay`` is the
+    ``slow_tick`` sleep in seconds.
     """
 
     point: str
     at: Optional[int]
     kind: str = KIND_CRASH
-    count: int = 1
+    count: Optional[int] = None
+    span: int = 1
+    delay: float = 0.0
 
     def __post_init__(self):
         if self.point not in POINTS:
             raise ValueError(f"unknown fault point {self.point!r}; one of {POINTS}")
         if self.kind not in KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}; one of {KINDS}")
+        if self.span < 1:
+            raise ValueError(f"span must be >= 1, got {self.span}")
+        if self.span > 1 and self.at is None:
+            raise ValueError("span needs an explicit start index (at=)")
+        if self.count is None:
+            # a burst is good for its whole width by default
+            self.count = self.span
         if self.count < 1:
             raise ValueError(f"count must be >= 1, got {self.count}")
+        if self.kind == KIND_SLOW_TICK and self.delay <= 0:
+            raise ValueError("slow_tick needs delay > 0 (seconds)")
 
 
 class FaultSchedule:
@@ -115,19 +141,42 @@ class FaultSchedule:
         rng = np.random.default_rng(seed)
         specs = []
         for _ in range(n_faults):
+            kind = kinds[int(rng.integers(len(kinds)))]
             specs.append(FaultSpec(
                 point=points[int(rng.integers(len(points)))],
                 at=int(rng.integers(index_range[0], index_range[1])),
-                kind=kinds[int(rng.integers(len(kinds)))],
+                kind=kind,
+                delay=0.05 if kind == KIND_SLOW_TICK else 0.0,
             ))
         return cls(specs)
+
+    @classmethod
+    def overflow_storm(
+        cls,
+        seed: int,
+        point: str = PRE_TRAIN_STEP,
+        start_range: Tuple[int, int] = (0, 20),
+        length_range: Tuple[int, int] = (3, 9),
+    ) -> "FaultSchedule":
+        """A seeded BURST of consecutive non-finite micro-batches: start
+        and length drawn from the ranges, then every index in
+        ``[start, start + length)`` poisons its batch with Inf — the
+        systematic-overflow scenario (loss-scale halving, all-bad
+        windows). Same seed, same storm, every time."""
+        rng = np.random.default_rng(seed)
+        start = int(rng.integers(start_range[0], start_range[1]))
+        length = int(rng.integers(length_range[0], length_range[1]))
+        return cls([FaultSpec(point, at=start, kind=KIND_OVERFLOW_STORM,
+                              span=length)])
 
     def match(self, point: str, index: int) -> Optional[FaultSpec]:
         """Consume and return the first armed spec matching (point, index)."""
         for i, spec in enumerate(self.specs):
             if self._remaining[i] <= 0 or spec.point != point:
                 continue
-            if spec.at is not None and spec.at != index:
+            if spec.at is not None and not (
+                spec.at <= index < spec.at + spec.span
+            ):
                 continue
             self._remaining[i] -= 1
             return spec
@@ -152,7 +201,12 @@ class FaultInjector:
             raise InjectedCrash(point, index)
         if spec.kind == KIND_IO_ERROR:
             raise InjectedIOError(point, index)
-        return spec.kind  # nan/inf: the call site corrupts its own data
+        if spec.kind == KIND_SLOW_TICK:
+            # a wedged-but-alive dispatch: stall OUTSIDE the lock (other
+            # threads' fault points must stay live), then proceed normally
+            time.sleep(spec.delay)
+            return spec.kind
+        return spec.kind  # data kinds: the call site corrupts its own data
 
 
 _ACTIVE: Optional[FaultInjector] = None
@@ -191,9 +245,13 @@ def fire(point: str, index: int) -> Optional[str]:
 
 def corrupt_batch(batch, kind: str):
     """Poison every float leaf of a host batch with NaN/Inf (returns a new
-    pytree; int leaves — token ids, labels — pass through untouched)."""
+    pytree; int leaves — token ids, labels — pass through untouched).
+    ``overflow_storm`` poisons with Inf — overflow is what it simulates."""
     import jax
 
+    if kind not in DATA_KINDS:
+        raise ValueError(f"corrupt_batch only applies data kinds "
+                         f"{DATA_KINDS}, got {kind!r}")
     bad = np.nan if kind == KIND_NAN else np.inf
 
     def poison(leaf):

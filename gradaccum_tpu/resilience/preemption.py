@@ -9,16 +9,33 @@ restarts from the exact step it was killed at (bitwise, per the
 crash-resume gate in tests/test_resilience.py).
 
 ``signal.signal`` only works on the main thread, so ``install()`` must run
-there (the handler chains to any previously-installed handler). The
-module-level :func:`requested` is what the training loop polls — it is a
-cheap list check when no handler is installed.
+there. Handlers CHAIN: installing keeps the previously-registered handler
+and forwards every signal to it; ``uninstall()`` restores it — and when
+some later code registered its own handler on top of ours, uninstall
+leaves the registration in place (restoring would clobber the newer
+handler) and simply deactivates this handler's observation while still
+forwarding along the chain. The module-level :func:`requested` is what the
+training loop polls — a cheap list check when no handler is installed.
+
+**Multi-host drain consensus** (:class:`DrainConsensus`): ``requested()``
+is a per-process flag, but the platform preempts WORKERS — on a multi-host
+job, one host's SIGTERM arriving a step earlier than another's would
+checkpoint different steps on different hosts, and the resumed job could
+never agree on where to continue. ``DrainConsensus.decide(requested,
+step)`` turns the local flag into a cluster-wide agreement: an all-reduce
+over ``jax.distributed`` (max of the request flags, max of the local
+steps) so every host learns (a) someone was preempted and (b) one common
+target step to drain to — every host then lands the SAME final checkpoint.
+The in-process fallback (``multiprocess=False`` + :class:`LocalDrainBus`)
+gives N simulated hosts in one process the identical protocol, which is
+how the tier-1 suite gates the contract without spawning a cluster.
 """
 
 from __future__ import annotations
 
 import signal
 import threading
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 _HANDLERS: List["PreemptionHandler"] = []
 
@@ -49,7 +66,14 @@ class PreemptionHandler:
     def __init__(self, signals: Sequence[int] = (signal.SIGTERM,)):
         self.signals = tuple(signals)
         self._event = threading.Event()
-        self._previous: Dict[int, object] = {}
+        # sig -> the closure registered with signal.signal for the CURRENT
+        # installation. Each registration is its own closure owning its own
+        # ``prev`` (captured at install time): a re-install after an
+        # out-of-order uninstall creates a FRESH closure chaining to the
+        # then-current handler, while the orphaned old closure keeps its
+        # original prev — per-instance mutable state here would let the two
+        # alias each other into a forwarding cycle.
+        self._registered: Dict[int, object] = {}
         self._installed = False
 
     @property
@@ -62,33 +86,246 @@ class PreemptionHandler:
     def reset(self) -> None:
         self._event.clear()
 
+    def _make_handler(self):
+        event = self._event
+
+        def handler(signum, frame):
+            if handler.active:
+                event.set()
+            if callable(handler.prev):
+                handler.prev(signum, frame)  # chain: observe, don't swallow
+
+        handler.active = True
+        handler.prev = None
+        return handler
+
     def install(self) -> "PreemptionHandler":
         if self._installed:
             return self
         for sig in self.signals:
-            self._previous[sig] = signal.signal(sig, self._on_signal)
+            fn = self._make_handler()
+            fn.prev = signal.signal(sig, fn)
+            self._registered[sig] = fn
         self._installed = True
         _HANDLERS.append(self)
         return self
 
     def uninstall(self) -> None:
+        """Restore the previously-registered handler — but NEVER clobber a
+        handler someone installed on top of this one: if the current
+        registration is not ours, the newer handler chains *through* our
+        closure, so the registration stays and this handler merely stops
+        observing (``active`` gates the event; forwarding to the closure's
+        own ``prev`` keeps working, so the chain stays intact)."""
         if not self._installed:
             return
-        for sig, prev in self._previous.items():
-            signal.signal(sig, prev)
-        self._previous.clear()
+        for sig, fn in self._registered.items():
+            fn.active = False
+            if signal.getsignal(sig) is fn:
+                signal.signal(sig, fn.prev)
+        self._registered.clear()
         self._installed = False
         if self in _HANDLERS:
             _HANDLERS.remove(self)
-
-    def _on_signal(self, signum, frame) -> None:
-        self._event.set()
-        prev = self._previous.get(signum)
-        if callable(prev):
-            prev(signum, frame)  # chain: we observe, we don't swallow
 
     def __enter__(self) -> "PreemptionHandler":
         return self.install()
 
     def __exit__(self, *exc) -> None:
         self.uninstall()
+
+
+# -- multi-host drain consensus ----------------------------------------------
+
+
+class LocalDrainBus:
+    """In-process consensus transport for SIMULATED hosts.
+
+    ``num_hosts`` participants (threads) rendezvous per round: each submits
+    ``(requested, step)``, the round resolves to ``(any requested, max
+    step)``, and every participant receives the identical result — the
+    same semantics as the ``jax.distributed`` all-reduce, minus the
+    cluster. Used by the tier-1 multi-host drain gate.
+    """
+
+    def __init__(self, num_hosts: int, timeout: float = 60.0):
+        if num_hosts < 1:
+            raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+        self.num_hosts = num_hosts
+        self.timeout = timeout
+        self._cond = threading.Condition()
+        self._round = 0
+        self._submitted: Dict[int, Tuple[bool, int]] = {}
+        self._results: Dict[int, Tuple[bool, int]] = {}
+
+    def exchange(self, host_id: int, requested: bool, step: int
+                 ) -> Tuple[bool, int]:
+        import time
+
+        with self._cond:
+            if host_id in self._submitted:
+                raise RuntimeError(
+                    f"host {host_id} submitted twice in round {self._round} "
+                    "— every host must call exchange() exactly once per round"
+                )
+            this_round = self._round
+            self._submitted[host_id] = (bool(requested), int(step))
+            if len(self._submitted) == self.num_hosts:
+                reqs = [r for r, _ in self._submitted.values()]
+                steps = [s for _, s in self._submitted.values()]
+                self._results[this_round] = (any(reqs), max(steps))
+                # keep only a short tail so a long run cannot grow the map
+                for old in [r for r in self._results if r < this_round - 1]:
+                    del self._results[old]
+                self._submitted = {}
+                self._round += 1
+                self._cond.notify_all()
+            else:
+                # bounded wait: a peer that died (crashed step_fn, shorter
+                # stream) must not hang the survivors — DrainConsensus
+                # treats the timeout like any transport failure and drains
+                # locally
+                deadline = time.monotonic() + self.timeout
+                while this_round not in self._results:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"LocalDrainBus round {this_round}: only "
+                            f"{len(self._submitted)}/{self.num_hosts} hosts "
+                            f"arrived within {self.timeout}s"
+                        )
+                    self._cond.wait(remaining)
+            return self._results[this_round]
+
+
+class DrainConsensus:
+    """Cross-host agreement on (drain?, common target step).
+
+    ``decide(requested, step)`` is a COLLECTIVE on the multiprocess path:
+    every host must call it at the same cadence (the Estimator polls once
+    per loop iteration and latches the first positive decision, so no host
+    keeps calling after another stopped). The decision is ``(any host
+    requested, max of the hosts' steps)`` — synchronous data-parallel
+    training keeps the hosts in lockstep, and max handles any skew by
+    letting stragglers catch up to the agreed step before checkpointing.
+
+    Transport: the ``jax.distributed`` coordination service's key-value
+    store plus a barrier — CONTROL-plane, deliberately not a device
+    collective. A preemption notice must go through even when the data
+    plane is the problem (wedged device, mid-dispatch), it works on every
+    backend (CPU multi-process included), and it adds no compiled program.
+    Each round every host publishes ``requested:step``, waits at the
+    round's barrier, reads all hosts' entries, and computes the identical
+    decision. If the transport fails (coordinator gone, a peer already
+    dead past the barrier timeout), the host drains LOCALLY at its own
+    step — landing a checkpoint beats hanging in a grace window.
+
+    ``interval`` throttles the real exchange to every Nth call (all hosts
+    count calls in lockstep, so they throttle identically); between
+    exchanges ``decide`` returns ``(False, step)``. On a TPU pod the
+    exchange is one coordinator RPC — poll every step for CPU tests, every
+    few seconds of steps in production.
+
+    ``multiprocess=None`` auto-detects ``jax.process_count() > 1``. With
+    ``multiprocess=False`` the decision goes through a
+    :class:`LocalDrainBus` when one is given (N simulated hosts in one
+    process), or degenerates to the local flag (a single host IS the
+    cluster). ``request()`` marks THIS participant preempted without a real
+    signal — deterministic tests, cooperative shutdown; the SIGTERM path
+    arrives through the ``requested`` argument instead.
+    """
+
+    def __init__(
+        self,
+        multiprocess: Optional[bool] = None,
+        bus: Optional[LocalDrainBus] = None,
+        host_id: int = 0,
+        interval: int = 1,
+        timeout_ms: int = 60_000,
+        key_prefix: str = "gradaccum/drain",
+    ):
+        if multiprocess is None:
+            import jax
+
+            multiprocess = jax.process_count() > 1
+        if multiprocess and bus is not None:
+            raise ValueError("bus is the in-process fallback transport; it "
+                             "cannot combine with multiprocess=True")
+        if interval < 1:
+            raise ValueError(f"interval must be >= 1, got {interval}")
+        self.multiprocess = bool(multiprocess)
+        self.bus = bus
+        self.host_id = host_id
+        self.interval = interval
+        self.timeout_ms = timeout_ms
+        self.key_prefix = key_prefix
+        self._local_request = False
+        self._calls = 0
+        self._round = 0
+
+    def request(self) -> None:
+        """Mark this host preempted (OR'd with the flag passed to decide)."""
+        self._local_request = True
+
+    def decide(self, requested: bool, step: int) -> Tuple[bool, int]:
+        req = bool(requested) or self._local_request
+        self._calls += 1
+        if not self.multiprocess and self.bus is None:
+            return req, int(step)  # a single host IS the cluster
+        if (self._calls - 1) % self.interval:
+            return False, int(step)
+        try:
+            if self.bus is not None:
+                return self.bus.exchange(self.host_id, req, int(step))
+            return self._kv_exchange(req, int(step))
+        except Exception as e:  # noqa: BLE001 — any transport failure
+            # a dead peer / lost coordinator must not strand this host in
+            # its grace window: landing a local checkpoint beats hanging
+            print(f"[preemption] drain consensus transport failed ({e}); "
+                  f"draining locally at step={step}"
+                  if req else
+                  f"[preemption] drain consensus transport failed ({e}); "
+                  f"continuing without consensus")
+            return (req, int(step))
+
+    # -- coordination-service transport ---------------------------------
+
+    def _client(self):
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "jax.distributed is not initialized — call "
+                "initialize_multihost()/jax.distributed.initialize() first, "
+                "or use multiprocess=False"
+            )
+        return client
+
+    def _kv_exchange(self, req: bool, step: int) -> Tuple[bool, int]:
+        import jax
+
+        client = self._client()
+        r = self._round
+        self._round += 1
+        pid = jax.process_index()
+        nproc = jax.process_count()
+        client.key_value_set(f"{self.key_prefix}/{r}/{pid}", f"{int(req)}:{step}")
+        client.wait_at_barrier(f"{self.key_prefix}-barrier-{r}",
+                               self.timeout_ms)
+        any_req, target = False, step
+        for p in range(nproc):
+            raw = client.blocking_key_value_get(
+                f"{self.key_prefix}/{r}/{p}", self.timeout_ms
+            )
+            flag, peer_step = raw.split(":")
+            any_req = any_req or flag == "1"
+            target = max(target, int(peer_step))
+        # best-effort cleanup of the previous round's keys
+        if r > 0:
+            try:
+                for p in range(nproc):
+                    client.key_value_delete(f"{self.key_prefix}/{r - 1}/{p}")
+            except Exception:  # noqa: BLE001 — cleanup only
+                pass
+        return any_req, target

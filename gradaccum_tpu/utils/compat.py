@@ -1,0 +1,89 @@
+"""jax version compatibility for the parallel layer (dependency-free, so
+``ops`` and ``parallel`` can both import it without cycles).
+
+The framework targets the modern shard_map world: top-level
+``jax.shard_map`` plus the varying-manual-axes (VMA) type system, where
+``lax.pcast`` moves values between axis-invariant and axis-varying and the
+transpose of differentiating an axis-INVARIANT parameter auto-inserts the
+cross-shard psum. Older jax releases (<= 0.4.x, like some CI containers)
+ship ``shard_map`` under ``jax.experimental`` and have no VMA at all: every
+value inside the mapped body is plainly device-local, nothing is
+auto-psummed, and ``lax.pcast`` does not exist.
+
+This module makes both worlds run the SAME step code:
+
+- :func:`shard_map` — ``jax.shard_map`` when present, else the
+  ``jax.experimental.shard_map`` one (with ``check_rep=False``: the static
+  replication checker predates several primitives the steps use, and the
+  explicit collectives below make the replication invariants true by
+  construction rather than by analysis).
+- :data:`HAS_VMA` — True when ``lax.pcast`` exists.
+- :func:`pcast_varying` — pcast a pytree to axis-varying under VMA; the
+  identity on old jax, where body values are already local.
+- :func:`psum_unsynced` — the collectives VMA's transpose would have
+  auto-inserted for invariant-parameter gradients: an explicit ``psum``
+  over the named axes on old jax, the identity under VMA (where the values
+  already arrived summed).
+
+Every call site states which invariant it restores; nothing here changes
+numerics on modern jax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+from jax import lax
+
+try:
+    _new_shard_map = jax.shard_map  # jax >= 0.6
+except AttributeError:
+    _new_shard_map = None
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+HAS_VMA = hasattr(lax, "pcast")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` on modern jax; the experimental one (sans the
+    static replication checker) on old jax."""
+    if _new_shard_map is not None:
+        return _new_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+        )
+    return _old_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
+
+def axis_size(axis: str):
+    """``lax.axis_size`` on modern jax; the classic ``psum(1, axis)`` idiom
+    (constant-folded at trace time) where it does not exist."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
+
+
+def pcast_varying(tree: Any, axis: Optional[str]):
+    """Mark ``tree`` axis-varying over ``axis`` before differentiation so
+    per-shard gradients stay LOCAL (one explicit psum at apply time instead
+    of an auto-psum per micro-batch). Old jax: identity — body values are
+    local already, which is exactly the wanted semantics."""
+    if axis is None or not HAS_VMA:
+        return tree
+    return jax.tree.map(lambda p: lax.pcast(p, axis, to="varying"), tree)
+
+
+def psum_unsynced(tree: Any, axes: Sequence[str] | Tuple[str, ...]):
+    """Sum ``tree`` over ``axes`` on old jax only.
+
+    Use where modern jax's VMA transpose auto-psums the gradient of an
+    axis-INVARIANT parameter (so the value is already the cross-shard sum):
+    on old jax that sum never happened and must be emitted explicitly.
+    Identity under VMA — never double-sums on modern jax.
+    """
+    axes = tuple(axes)
+    if HAS_VMA or not axes:
+        return tree
+    return lax.psum(tree, axes)

@@ -188,9 +188,96 @@ def main_hybrid(process_id: int, num_processes: int, port: int) -> None:
     )
 
 
+def main_preempt(process_id: int, num_processes: int, port: int,
+                 out_dir: str) -> None:
+    """Multi-host preemption consensus over a REAL jax.distributed cluster:
+    process 1 is 'preempted' mid-run (cooperative ``DrainConsensus.request``
+    — the SIGTERM path flips the same flag), and the consensus all-reduce
+    must stop EVERY process at one common target step so all hosts land
+    the same final checkpoint. Each worker prints its stop step and the
+    sha256 of its checkpoint file; the test asserts they are identical
+    across workers — the drain contract, bitwise."""
+    import hashlib
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.estimator.config import RunConfig
+    from gradaccum_tpu.estimator.estimator import Estimator, ModelBundle
+    from gradaccum_tpu.estimator.metrics import mean_absolute_error
+    from gradaccum_tpu.parallel.mesh import initialize_multihost
+    from gradaccum_tpu.resilience.preemption import DrainConsensus
+
+    info = initialize_multihost(
+        coordinator_address=f"localhost:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert info["process_count"] == num_processes, info
+
+    def init(rng, sample):
+        del rng, sample
+        return {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}
+
+    def loss(params, batch):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    bundle = ModelBundle(
+        init=init, loss=loss,
+        predict=lambda p, b: {"predictions": b["x"] @ p["w"] + p["b"]},
+        eval_metrics={"mae": mean_absolute_error(label_key="y")},
+    )
+
+    rng = np.random.default_rng(5)
+    data = []
+    for _ in range(40):
+        x = rng.normal(size=(8, 3)).astype(np.float32)
+        y = (x @ np.asarray([[1.0], [-2.0], [0.5]], np.float32)).astype(
+            np.float32
+        )
+        data.append({"x": x, "y": y})
+
+    cons = DrainConsensus()  # auto-detects the multiprocess cluster
+    assert cons.multiprocess, "worker must take the jax.distributed path"
+    model_dir = os.path.join(out_dir, f"host{process_id}")
+    est = Estimator(
+        bundle, gt.ops.sgd(0.05),
+        gt.GradAccumConfig(num_micro_batches=4),
+        RunConfig(model_dir=model_dir, save_checkpoints_steps=None,
+                  log_step_count_steps=1000, drain_consensus=cons),
+        mode="streaming",
+    )
+
+    def stream():
+        for i, batch in enumerate(data):
+            if process_id == 1 and i == 17:
+                cons.request()  # only THIS host is preempted
+            yield batch
+
+    state = est.train(stream(), max_steps=40)
+    stop = est.drained_at_step
+    assert stop is not None and 0 < stop < 40, stop
+    assert int(jax.device_get(state.step)) == stop
+    from gradaccum_tpu.estimator import checkpoint as ckpt_lib
+
+    ckpt_step, ckpt_path = ckpt_lib.latest_checkpoint(model_dir)
+    assert ckpt_step == stop, (ckpt_step, stop)
+    digest = hashlib.sha256(open(ckpt_path, "rb").read()).hexdigest()
+    print(
+        f"MULTIHOST_PREEMPT_OK process={process_id}/{num_processes} "
+        f"stop={stop} sha256={digest}"
+    )
+
+
 if __name__ == "__main__":
     mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
     if mode == "hybrid":
         main_hybrid(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]))
+    elif mode == "preempt":
+        main_preempt(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
+                     sys.argv[5])
     else:
         main(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]))

@@ -1,0 +1,31 @@
+"""CI wiring for the seeded chaos smoke (tools/chaos_smoke.py).
+
+Slow lane by design: the smoke trains through an injected kill + overflow
+storm + flaky checkpoint disk, then serves through a decode-tick crash and
+a slow tick, and refreshes BENCH_chaos.json — whose acceptance block
+``tools/bench_trend.py`` gates on. Run just this with ``pytest -m chaos``.
+"""
+
+import os
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_chaos_smoke_passes_and_refreshes_artifact():
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import chaos_smoke
+
+    rc = chaos_smoke.main(["--seed", str(0xC8A05)])
+    assert rc == 0
+    import json
+
+    with open(os.path.join(_REPO, "BENCH_chaos.json")) as f:
+        artifact = json.load(f)
+    assert artifact["acceptance"]["passed"] is True
+    assert artifact["detail"]["train"]["crashes"] >= 1
+    assert artifact["detail"]["serve"]["requests"] == 6

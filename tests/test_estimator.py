@@ -602,8 +602,11 @@ def test_export_model_bert(rng, tmp_path):
     other = batch(6)
     got = load_exported(d)(other)
     want = bundle.predict(params, other)
+    # atol: the StableHLO round-trip may re-fuse near-zero logits a few ULP
+    # away from the eager value on some jax/XLA versions
     np.testing.assert_allclose(
-        np.asarray(got["logits"]), np.asarray(want["logits"]), rtol=1e-6
+        np.asarray(got["logits"]), np.asarray(want["logits"]), rtol=1e-6,
+        atol=1e-6,
     )
     np.testing.assert_array_equal(
         np.asarray(got["classes"]), np.asarray(want["classes"])

@@ -79,6 +79,50 @@ def test_two_process_dp_step():
 
 
 @pytest.mark.slow
+@pytest.mark.faults
+def test_two_process_preemption_consensus_drains_to_common_step(tmp_path):
+    """One of two REAL processes is preempted mid-run; the DrainConsensus
+    all-reduce over jax.distributed must stop BOTH at the same agreed step
+    with byte-identical final checkpoints (the multi-host drain contract)."""
+    port = _free_port()
+    env = _worker_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(i), "2", str(port), "preempt",
+             str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=_TIMEOUT_S)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip(f"preemption consensus test timed out after {_TIMEOUT_S}s")
+
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert "MULTIHOST_PREEMPT_OK" in out, f"worker {i} missing OK line:\n{out}"
+
+    def ok_line(out):
+        return [l for l in out.splitlines()
+                if l.startswith("MULTIHOST_PREEMPT_OK")][0]
+
+    fields0 = dict(kv.split("=") for kv in ok_line(outs[0]).split()[1:])
+    fields1 = dict(kv.split("=") for kv in ok_line(outs[1]).split()[1:])
+    # same agreed stop step on both hosts, and bitwise-identical checkpoints
+    assert fields0["stop"] == fields1["stop"]
+    assert fields0["sha256"] == fields1["sha256"]
+
+
+@pytest.mark.slow
 def test_two_process_hybrid_mesh_model_sharding():
     """make_hybrid_mesh across real processes: 'data' (DCN) spans the two
     workers, 'model' (ICI) stays on each worker's local devices, and the

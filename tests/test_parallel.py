@@ -29,6 +29,7 @@ from gradaccum_tpu.parallel.sharding import (
     param_shardings,
     shard_params,
 )
+from gradaccum_tpu.utils import compat
 
 D = 8  # virtual devices (conftest)
 K = 2
@@ -238,7 +239,7 @@ def test_cross_shard_optimizer_means_gradients(rng):
         return new_params
 
     out = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             shard_fn, mesh=mesh, in_specs=(P(), P("data")), out_specs=P()
         )
     )(params, per_replica)
@@ -269,7 +270,7 @@ def test_cross_shard_optimizer_sum_and_validation(rng):
         return new_params
 
     out = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             shard_fn, mesh=mesh, in_specs=(P(), P("data")), out_specs=P()
         )
     )(params, per_replica)

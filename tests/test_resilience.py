@@ -406,17 +406,296 @@ def test_streaming_all_bad_window_skips_apply_entirely():
                               np.asarray(params0["w"]))
 
 
-def test_skip_nonfinite_rejected_on_unsupported_paths():
+def test_guard_knob_validation():
+    """normalize_by_good_count / loss_scale ride on the guard — without
+    skip_nonfinite they must be rejected at build time, and loss scaling is
+    explicitly not implemented for the pipeline step."""
+    from gradaccum_tpu.ops.loss_scale import LossScaleConfig
+
+    with pytest.raises(ValueError, match="normalize_by_good_count"):
+        acc.validate_config(acc.GradAccumConfig(
+            num_micro_batches=K, normalize_by_good_count=True))
+    with pytest.raises(ValueError, match="loss scaling"):
+        acc.validate_config(acc.GradAccumConfig(
+            num_micro_batches=K, loss_scale=LossScaleConfig()))
+    # the old refusal is GONE: a seq-mesh estimator with the guard builds
     from gradaccum_tpu.parallel.mesh import make_mesh
 
     mesh = make_mesh(data=2, seq=4)
-    with pytest.raises(ValueError, match="skip_nonfinite"):
-        Estimator(
-            _bundle(), sgd(0.05),
-            acc.GradAccumConfig(num_micro_batches=K, skip_nonfinite=True,
-                                first_step_quirk=False),
-            RunConfig(), mesh=mesh, mode="scan",
-        )
+    Estimator(
+        _bundle(), sgd(0.05),
+        acc.GradAccumConfig(num_micro_batches=K, skip_nonfinite=True,
+                            first_step_quirk=False),
+        RunConfig(), mesh=mesh, mode="scan",
+    )
+
+
+def test_normalize_by_good_count_rescales_over_survivors():
+    """With good-count normalization a skipped micro-batch rescales the
+    update over the survivors: the window's update equals the mean over the
+    GOOD micro-batches only (denominator n_good, not K)."""
+    bundle = _bundle()
+    opt = sgd(0.05)
+    data = _batches(K, seed=21)
+    params0 = {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}
+
+    cfg = acc.GradAccumConfig(num_micro_batches=K, first_step_quirk=False,
+                              skip_nonfinite=True,
+                              normalize_by_good_count=True)
+    step_fn = jax.jit(acc.streaming_step(bundle.loss, opt, cfg))
+    state = acc.streaming_init(params0, opt)
+    bad = {"x": np.full((8, 3), np.nan, np.float32),
+           "y": np.zeros((8, 1), np.float32)}
+    for i in range(K):
+        state, aux = step_fn(state, bad if i == 1 else data[i])
+    assert int(aux["applied"]) == 1
+
+    # reference: mean gradient over the K-1 good micro-batches (window of
+    # size K-1 with denominator K-1) — same single update
+    cfg_ref = acc.GradAccumConfig(num_micro_batches=K - 1,
+                                  first_step_quirk=False)
+    ref_fn = jax.jit(acc.streaming_step(bundle.loss, opt, cfg_ref))
+    ref = acc.streaming_init(params0, opt)
+    for i in range(K):
+        if i == 1:
+            continue
+        ref, _ = ref_fn(ref, data[i])
+    # ULP-level only: XLA rewrites the reference's divide-by-CONSTANT K-1
+    # into multiply-by-reciprocal, while the good-count denominator is a
+    # traced value and emits a true divide
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6
+        ),
+        jax.device_get(state.params), jax.device_get(ref.params),
+    )
+
+
+# -- overflow storms + dynamic loss scaling -----------------------------------
+
+
+def test_overflow_storm_schedule_is_seeded_and_consecutive():
+    a = FaultSchedule.overflow_storm(77)
+    b = FaultSchedule.overflow_storm(77)
+    assert [(s.point, s.at, s.kind, s.span) for s in a.specs] == \
+           [(s.point, s.at, s.kind, s.span) for s in b.specs]
+    spec = a.specs[0]
+    assert spec.kind == faults.KIND_OVERFLOW_STORM and spec.span >= 3
+    inj = FaultInjector(a)
+    fired = [inj.fire(faults.PRE_TRAIN_STEP, i) for i in range(40)]
+    hits = [i for i, kind in enumerate(fired) if kind is not None]
+    assert hits == list(range(spec.at, spec.at + spec.span))  # consecutive
+
+
+def test_overflow_storm_with_loss_scaling_recovers(tmp_path):
+    """ACCEPTANCE GATE: an overflow_storm under dynamic loss scaling
+    recovers to a finite loss, and the loss-scale series shows at least one
+    halve-then-regrow cycle (persistent overflow self-heals instead of
+    permanently shrinking updates)."""
+    from gradaccum_tpu.ops.loss_scale import LossScaleConfig
+
+    est = Estimator(
+        _bundle(), sgd(0.05),
+        acc.GradAccumConfig(
+            num_micro_batches=K, first_step_quirk=False,
+            skip_nonfinite=True, normalize_by_good_count=True,
+            loss_scale=LossScaleConfig(init_scale=16.0, growth_interval=2),
+        ),
+        RunConfig(model_dir=str(tmp_path), save_checkpoints_steps=None,
+                  log_step_count_steps=1000),
+        mode="streaming",
+    )
+    n_steps = 40
+    inj = FaultInjector(FaultSchedule.overflow_storm(
+        0xBADF100D, start_range=(8, 9), length_range=(2 * K, 2 * K + 1)
+    ))
+    with faults.installed(inj):
+        state = est.train(_batches(n_steps, seed=5), max_steps=n_steps)
+
+    assert est.nonfinite_skips == 2 * K  # the whole storm was skipped
+    # the run ends healthy: finite params and a finite logged loss
+    for leaf in jax.tree.leaves(jax.device_get(state.params)):
+        assert np.all(np.isfinite(leaf))
+    losses = _loss_by_step(str(tmp_path))
+    assert losses and np.isfinite(float(losses[max(losses)]))
+    # the scale series halved during the storm and regrew after it
+    scales = [v for _, v in est.loss_scale_series]
+    halves = [i for i in range(1, len(scales)) if scales[i] < scales[i - 1]]
+    grows = [i for i in range(1, len(scales)) if scales[i] > scales[i - 1]]
+    assert halves, f"no halve in scale series {scales}"
+    assert any(g > halves[0] for g in grows), \
+        f"no regrow after the halve: {scales}"
+    # good_count series flowed too (skipped windows show 0 good)
+    assert est.good_count_series
+    assert min(v for _, v in est.good_count_series) == 0
+
+
+# -- multi-host preemption consensus ------------------------------------------
+
+
+def test_local_drain_bus_agrees_on_any_and_max():
+    import threading
+
+    bus = preemption.LocalDrainBus(3)
+    results = {}
+
+    def host(hid, req, step):
+        results[hid] = bus.exchange(hid, req, step)
+
+    threads = [
+        threading.Thread(target=host, args=(0, False, 7)),
+        threading.Thread(target=host, args=(1, True, 9)),
+        threading.Thread(target=host, args=(2, False, 8)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {0: (True, 9), 1: (True, 9), 2: (True, 9)}
+
+
+def test_drain_consensus_single_host_fallback():
+    cons = preemption.DrainConsensus(multiprocess=False)
+    assert cons.decide(False, 5) == (False, 5)
+    cons.request()
+    assert cons.decide(False, 6) == (True, 6)
+
+
+def test_simulated_two_host_drain_lands_identical_checkpoints(tmp_path):
+    """ACCEPTANCE GATE (multi-host drain contract): two simulated hosts
+    training the same stream; ONE is preempted mid-run. The consensus must
+    stop BOTH at the same agreed step with bitwise-identical checkpoints,
+    and both resume to a bitwise-identical end state vs an uninterrupted
+    run."""
+    import threading
+
+    n_steps = 30
+    data = _batches(n_steps, seed=13)
+
+    # uninterrupted single-host reference
+    est_ref = _estimator(str(tmp_path / "ref"), save_every=None)
+    ref_state = est_ref.train(data, max_steps=n_steps)
+
+    bus = preemption.LocalDrainBus(2)
+    results = {}
+    errors = []
+
+    def host(hid):
+        try:
+            cons = preemption.DrainConsensus(
+                multiprocess=False, bus=bus, host_id=hid
+            )
+            est = Estimator(
+                _bundle(), sgd(0.05),
+                acc.GradAccumConfig(num_micro_batches=K),
+                RunConfig(model_dir=str(tmp_path / f"host{hid}"),
+                          save_checkpoints_steps=None,
+                          log_step_count_steps=1000,
+                          drain_consensus=cons),
+                mode="streaming",
+            )
+
+            def stream():
+                for i, b in enumerate(data):
+                    if hid == 0 and i == 11:
+                        cons.request()  # host 0 alone is preempted
+                    yield b
+
+            state = est.train(stream(), max_steps=n_steps)
+            results[hid] = (est.drained_at_step, jax.device_get(state))
+        except BaseException as e:  # noqa: BLE001 — surfaced by the test
+            errors.append((hid, e))
+
+    threads = [threading.Thread(target=host, args=(h,)) for h in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+    stop0, state0 = results[0]
+    stop1, state1 = results[1]
+    assert stop0 == stop1 and stop0 is not None and 0 < stop0 < n_steps
+    _assert_states_equal(state0, state1)  # same step, same params, bitwise
+    # both hosts' final checkpoints landed at the agreed step and agree
+    for hid in (0, 1):
+        step_no, _ = ckpt_lib.latest_checkpoint(str(tmp_path / f"host{hid}"))
+        assert step_no == stop0
+    r0 = ckpt_lib.restore(str(tmp_path / "host0"), jax.device_get(state0))
+    r1 = ckpt_lib.restore(str(tmp_path / "host1"), jax.device_get(state1))
+    _assert_states_equal(r0, r1)
+    # and both resume to the uninterrupted trajectory, bitwise
+    for hid in (0, 1):
+        est = _estimator(str(tmp_path / f"host{hid}"), save_every=None)
+        final = est.train(data[stop0:], max_steps=n_steps)
+        _assert_states_equal(final, ref_state)
+
+
+def test_preemption_handler_chains_and_uninstalls_out_of_order():
+    """A chained stack of handlers must survive OUT-OF-ORDER uninstall:
+    removing the middle handler may not clobber the newer registration,
+    the uninstalled handler stops observing, and the base handler still
+    fires (chained through, not swallowed)."""
+    base_calls = []
+
+    def base_handler(signum, frame):
+        base_calls.append(signum)
+
+    original = signal.signal(signal.SIGTERM, base_handler)
+    try:
+        a = preemption.PreemptionHandler().install()
+        b = preemption.PreemptionHandler().install()
+        a.uninstall()  # out of order: b was installed after a
+        # b's registration survives
+        assert signal.getsignal(signal.SIGTERM) is b._registered[signal.SIGTERM]
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert b.triggered
+        assert not a.triggered  # uninstalled: observes nothing
+        assert base_calls == [signal.SIGTERM]  # chain reached the base
+        assert preemption.requested()  # b is still installed
+        b.uninstall()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert base_calls[-1] == signal.SIGTERM and len(base_calls) >= 2
+        assert not preemption.requested()
+    finally:
+        signal.signal(signal.SIGTERM, original)
+
+
+def test_preemption_reinstall_after_out_of_order_uninstall_no_cycle():
+    """Regression: a.install, b.install, a.uninstall (b stays on top),
+    a.install AGAIN — the fresh registration must chain a→b→(a's orphaned
+    closure)→base without forming a forwarding cycle (per-registration
+    closures own their prev; shared mutable state would alias a's old and
+    new registrations into infinite recursion inside the signal handler)."""
+    base_calls = []
+
+    def base_handler(signum, frame):
+        base_calls.append(signum)
+
+    original = signal.signal(signal.SIGTERM, base_handler)
+    try:
+        a = preemption.PreemptionHandler().install()
+        b = preemption.PreemptionHandler().install()
+        a.uninstall()  # out of order: b's registration survives
+        a.install()  # back on top of b
+        os.kill(os.getpid(), signal.SIGTERM)  # a cycle would RecursionError
+        assert a.triggered and b.triggered
+        assert base_calls == [signal.SIGTERM]  # base fired exactly once
+        a.uninstall()
+        b.uninstall()
+    finally:
+        signal.signal(signal.SIGTERM, original)
+
+
+def test_drain_bus_dead_peer_times_out_and_survivor_drains_locally():
+    """A simulated host that died (never exchanges again) must not hang the
+    survivor: the bus times out and DrainConsensus falls back to a local
+    drain decision instead of blocking forever."""
+    bus = preemption.LocalDrainBus(2, timeout=0.2)
+    cons = preemption.DrainConsensus(multiprocess=False, bus=bus, host_id=0)
+    cons.request()
+    drain, target = cons.decide(False, 9)  # peer (host 1) never shows up
+    assert (drain, target) == (True, 9)  # local drain, not a hang
 
 
 # -- preemption + resource lifecycle -----------------------------------------

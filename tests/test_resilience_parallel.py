@@ -1,0 +1,548 @@
+"""Resilience on the PARALLEL training paths: sp, pp, sparse-embed.
+
+PR 2 proved the fault-tolerance contract on the single-host scan/streaming
+steps; this file proves the same guarantees now hold on every path the
+repo ships. Headline gates (tier-1, ``-m faults``):
+
+- seeded mid-run crash-kill on the sp path (2-shard ``seq`` mesh) with a
+  NaN-poisoned window in the trajectory resumes BITWISE identical;
+- the guard is free when clean: ``skip_nonfinite=True`` with zero injected
+  faults is bitwise identical to ``False`` on the sp and sparse-embed
+  paths;
+- pp's per-stage guard masks a poisoned micro-batch on every shard (pipe
+  AND data agree), all-bad windows carry params/moments over bitwise.
+
+A ``slow``-marked micro-bench records the guard's step-time overhead into
+``BENCH_resilience.json`` for ``tools/bench_trend.py``.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+import gradaccum_tpu as gt
+from gradaccum_tpu.estimator import checkpoint as ckpt_lib
+from gradaccum_tpu.utils import compat
+from gradaccum_tpu.estimator.config import RunConfig
+from gradaccum_tpu.estimator.estimator import Estimator, ModelBundle
+from gradaccum_tpu.estimator.metrics import mean_absolute_error
+from gradaccum_tpu.ops import accumulation as acc
+from gradaccum_tpu.ops.adamw import adam, sgd
+from gradaccum_tpu.ops.sparse_embed import (
+    SparseEmbedHooks,
+    accumulate_scan_sparse_embed,
+)
+from gradaccum_tpu.parallel.mesh import make_mesh
+from gradaccum_tpu.parallel.pp import make_pp_train_step, pp_init, stack_stage_params
+from gradaccum_tpu.resilience import faults
+from gradaccum_tpu.resilience.faults import FaultInjector, FaultSchedule, FaultSpec
+
+pytestmark = pytest.mark.faults
+
+K = 2  # micro-batches per window (sp/scan tests)
+B = 4  # examples per micro-batch
+S = 8  # global sequence length (sharded over 'seq')
+F = 3
+
+
+def _assert_trees_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        jax.device_get(a), jax.device_get(b),
+    )
+
+
+# -- the sp path --------------------------------------------------------------
+
+
+def _sp_bundle():
+    """Tiny seq-AWARE model: the token dim of batch["x"] is sharded over
+    'seq', the pooled feature is psum'd across the token shards — the same
+    shape of seq-awareness as the BERT sp bundle, small enough for tier-1."""
+
+    def init(rng, sample):
+        del rng, sample
+        return {
+            "w1": jnp.full((F, 4), 0.1, jnp.float32),
+            "w2": jnp.full((4, 1), 0.2, jnp.float32),
+            "b": jnp.zeros((1,), jnp.float32),
+        }
+
+    def loss(params, batch):
+        # batch["x"]: [B, S_local, F] — this rank's token block only.
+        # Global pooling as pmean×n rather than psum: pmean's transpose is
+        # exact on pre-VMA jax too (psum's historically re-psums the
+        # cotangent), so gradient magnitudes are true in both worlds.
+        local = jnp.einsum("bsf,fh->bh", batch["x"], params["w1"])
+        pooled = lax.pmean(local, "seq") * compat.axis_size("seq")
+        pred = jnp.tanh(pooled) @ params["w2"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def predict(params, batch):  # dense twin is out of scope here
+        return {"predictions": batch["y"]}
+
+    return ModelBundle(
+        init=init, loss=loss, predict=predict,
+        eval_metrics={"mae": mean_absolute_error(label_key="y")},
+        seq_keys=("x",),
+    )
+
+
+def _sp_batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.normal(size=(K * B, S, F)).astype(np.float32)
+        y = rng.normal(size=(K * B, 1)).astype(np.float32)
+        out.append({"x": x, "y": y})
+    return out
+
+
+def _sp_mesh():
+    return make_mesh(data=1, seq=2, devices=jax.devices()[:2])
+
+
+def _sp_estimator(model_dir, save_every=6, skip=True):
+    return Estimator(
+        _sp_bundle(), sgd(0.05),
+        acc.GradAccumConfig(num_micro_batches=K, skip_nonfinite=skip,
+                            first_step_quirk=False),
+        RunConfig(model_dir=model_dir, save_checkpoints_steps=save_every,
+                  log_step_count_steps=1000),
+        mesh=_sp_mesh(), mode="scan",
+    )
+
+
+def test_sp_crash_resume_bitwise_identical(tmp_path):
+    """ACCEPTANCE GATE: seeded crash-kill on the sp path (2-shard 'seq'
+    mesh), with a NaN-poisoned (all-bad, apply-skipped) window inside the
+    trajectory, resumes from the last checkpoint to a bitwise-identical
+    param/loss trajectory."""
+    n_steps = 24
+    nan_at = 4  # PRE index (before the step): poisons one whole window
+    crash_at = int(  # seeded POST index, strictly between checkpoints
+        np.random.default_rng(0x5EED5EED).integers(4, 6)
+    ) * K  # {8, 10}: after the ckpt at 6, before the one at 12
+    assert crash_at % 6 != 0
+
+    # uninterrupted reference (same injected NaN window)
+    est_a = _sp_estimator(str(tmp_path / "a"))
+    inj_a = FaultInjector(FaultSchedule(
+        [FaultSpec(faults.PRE_TRAIN_STEP, at=nan_at, kind=faults.KIND_NAN)]
+    ))
+    with faults.installed(inj_a):
+        state_a = est_a.train(_sp_batches(n_steps // K), max_steps=n_steps)
+    assert est_a.nonfinite_skips == K  # the poisoned window was all-bad
+
+    # crashed run: same NaN, then a crash mid-run
+    est_b = _sp_estimator(str(tmp_path / "b"))
+    inj_b = FaultInjector(FaultSchedule([
+        FaultSpec(faults.PRE_TRAIN_STEP, at=nan_at, kind=faults.KIND_NAN),
+        FaultSpec(faults.POST_TRAIN_STEP, at=crash_at),
+    ]))
+    with faults.installed(inj_b):
+        with pytest.raises(faults.InjectedCrash):
+            est_b.train(_sp_batches(n_steps // K), max_steps=n_steps)
+
+    ckpt_step, _ = ckpt_lib.latest_checkpoint(str(tmp_path / "b"))
+    assert 0 < ckpt_step < crash_at
+    est_b2 = _sp_estimator(str(tmp_path / "b"))
+    state_b = est_b2.train(
+        _sp_batches(n_steps // K)[ckpt_step // K:], max_steps=n_steps
+    )
+
+    assert int(state_b.step) == n_steps
+    _assert_trees_equal(state_a, state_b)
+    # post-resume loss rows are bitwise identical too
+    def loss_rows(d):
+        path = os.path.join(d, "loss_vs_step.csv")
+        with open(path) as f:
+            next(f)
+            return dict(line.strip().split(",") for line in f)
+
+    rows_a, rows_b = loss_rows(str(tmp_path / "a")), loss_rows(str(tmp_path / "b"))
+    resumed = [s for s in rows_b if int(s) > ckpt_step]
+    assert resumed
+    for s in resumed:
+        assert rows_b[s] == rows_a[s], f"loss diverged at step {s}"
+
+
+def test_sp_guard_parity_with_zero_faults(tmp_path):
+    """Guard-off vs guard-on with NO faults is bitwise identical on the sp
+    path — enabling the protection costs no numerics."""
+    data = _sp_batches(6, seed=9)
+    est_on = _sp_estimator(str(tmp_path / "on"), save_every=None, skip=True)
+    est_off = _sp_estimator(str(tmp_path / "off"), save_every=None, skip=False)
+    state_on = est_on.train(data, max_steps=12)
+    state_off = est_off.train(data, max_steps=12)
+    assert est_on.nonfinite_skips == 0
+    _assert_trees_equal(state_on.params, state_off.params)
+    _assert_trees_equal(state_on.opt_state, state_off.opt_state)
+
+
+def test_sp_partial_shard_nan_skips_micro_batch_everywhere():
+    """A micro-batch that is non-finite on ONE seq shard only must be
+    skipped on ALL shards (pmin agreement): the update equals the same
+    window with that micro-batch's gradient exactly zeroed."""
+    bundle = _sp_bundle()
+    opt = sgd(0.05)
+    mesh = _sp_mesh()
+    from gradaccum_tpu.parallel.sp import make_dp_sp_train_step
+
+    cfg = acc.GradAccumConfig(num_micro_batches=K, skip_nonfinite=True)
+    step = make_dp_sp_train_step(bundle.loss, opt, cfg, mesh, seq_keys=("x",))
+
+    batch = _sp_batches(1, seed=3)[0]
+    stacked = gt.stack_micro_batches(batch, K)
+    # poison ONLY the second seq shard's token block of micro-batch 0
+    bad = stacked.copy()
+    x = np.array(stacked["x"])
+    x[0, :, S // 2:, :] = np.nan  # tokens S/2.. live on seq rank 1
+    bad = dict(stacked, x=x)
+
+    params = bundle.init(None, None)
+    state, aux = step(acc.scan_init(params, opt), bad)
+    assert int(aux["skipped"]) == 1 and int(aux["good_count"]) == 1
+
+    # reference: same window, micro-batch 0 contributing ZERO gradient —
+    # feed only micro 1 through a K=1 window with denominator K=2 worth of
+    # normalization (skip keeps denom K, so halve the lr instead)
+    ref_step = make_dp_sp_train_step(
+        bundle.loss, sgd(0.05 / K), acc.GradAccumConfig(num_micro_batches=1),
+        mesh, seq_keys=("x",),
+    )
+    micro1 = jax.tree.map(lambda l: l[1:2], stacked)
+    # fresh params: the guarded step above DONATED its state
+    ref_state, _ = ref_step(
+        acc.scan_init(bundle.init(None, None), sgd(0.05 / K)), micro1
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        jax.device_get(state.params), jax.device_get(ref_state.params),
+    )
+
+
+# -- the pp path --------------------------------------------------------------
+
+D_PP = 8
+B_PP = 4
+
+
+def _pp_stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _pp_loss_fn(out, labels):
+    return jnp.mean((out - labels["y"]) ** 2)
+
+
+def _pp_stages(seed, n_stages):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "w": jnp.asarray(rng.normal(scale=0.5, size=(D_PP, D_PP)), jnp.float32),
+            "b": jnp.asarray(rng.normal(scale=0.1, size=(D_PP,)), jnp.float32),
+        }
+        for _ in range(n_stages)
+    ]
+
+
+def _pp_batch(seed, k):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": np.asarray(rng.normal(size=(k, B_PP, D_PP)), np.float32),
+        "y": np.asarray(rng.normal(size=(k, B_PP, D_PP)), np.float32),
+    }
+
+
+def _pp_masked_reference(stages, batch, opt, k, bad_micros, denom=None):
+    """Sequential ground truth: bad micro-batches' losses masked out of the
+    window mean (their gradients are exactly zero)."""
+    stacked = stack_stage_params(stages)
+    denom = k if denom is None else denom
+    good = np.asarray([j not in bad_micros for j in range(k)], np.float32)
+
+    def full_loss(sp):
+        def per_micro(x, y):
+            h = x
+            for s in range(len(stages)):
+                h = _pp_stage_fn(jax.tree.map(lambda p: p[s], sp), h)
+            return jnp.mean((h - y) ** 2)
+
+        x = jnp.nan_to_num(jnp.asarray(batch["x"]))  # bad micros are masked
+        losses = jax.vmap(per_micro)(x, jnp.asarray(batch["y"]))
+        return jnp.sum(losses * good) / denom
+
+    loss, grads = jax.value_and_grad(full_loss)(stacked)
+    new_params, _ = opt.update(
+        grads, opt.init(stacked), stacked, jnp.asarray(k, jnp.int32)
+    )
+    return loss, new_params
+
+
+def test_pp_guard_skips_poisoned_micro_batch():
+    """A NaN micro-batch under pp is masked on every stage: the update
+    matches the sequential reference with that micro-batch's gradient
+    exactly zero (denominator stays K)."""
+    n_stages, k = 2, 4
+    mesh = make_mesh(pipe=n_stages, devices=jax.devices()[:n_stages])
+    stages = _pp_stages(11, n_stages)
+    batch = _pp_batch(12, k)
+    batch["x"][1] = np.nan  # poison micro-batch 1 end-to-end
+    opt = sgd(0.5)
+
+    step = make_pp_train_step(_pp_stage_fn, _pp_loss_fn, opt, k, mesh,
+                              skip_nonfinite=True)
+    state, aux = step(pp_init(stages, opt), batch)
+    assert int(aux["skipped"]) == 1 and int(aux["good_count"]) == k - 1
+    assert np.isfinite(float(aux["loss"]))
+
+    _, ref_params = _pp_masked_reference(stages, batch, opt, k, {1})
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        jax.device_get(state.params), jax.device_get(ref_params),
+    )
+
+
+def test_pp_all_bad_window_is_bitwise_noop():
+    """Every micro-batch poisoned: the pp apply must be cond-skipped with
+    params AND optimizer moments carried over bitwise (Adam on a zero
+    gradient would decay and advance moments)."""
+    n_stages, k = 2, 4
+    mesh = make_mesh(pipe=n_stages, devices=jax.devices()[:n_stages])
+    stages = _pp_stages(13, n_stages)
+    batch = _pp_batch(14, k)
+    batch["x"][:] = np.inf
+    opt = adam(1e-2)
+
+    state0 = pp_init(stages, opt)
+    step = make_pp_train_step(_pp_stage_fn, _pp_loss_fn, opt, k, mesh,
+                              skip_nonfinite=True)
+    state, aux = step(state0, batch)
+    assert int(aux["skipped"]) == k and int(aux["good_count"]) == 0
+    assert np.isnan(float(aux["loss"]))  # the log shows the dead window
+    ref = pp_init(stages, opt)  # state0 was donated: rebuild it
+    _assert_trees_equal(state.params, ref.params)
+    _assert_trees_equal(state.opt_state, ref.opt_state)
+    assert int(state.step) == k  # the counter still advances
+
+
+def test_dp_pp_shard_local_nan_skips_globally():
+    """dp×pp: a micro-batch poisoned in ONE data shard's slice only must be
+    skipped on BOTH data shards (pmin over data) — the update matches the
+    reference with that micro-batch masked globally."""
+    n_stages, dp, k = 2, 2, 4
+    mesh = make_mesh(pipe=n_stages, data=dp,
+                     devices=jax.devices()[:n_stages * dp])
+    stages = _pp_stages(15, n_stages)
+    batch = _pp_batch(16, k)
+    # shard 0 holds rows [0, B/2): poison micro 2 there only
+    batch["x"][2, : B_PP // 2] = np.nan
+    opt = sgd(0.5)
+
+    step = make_pp_train_step(_pp_stage_fn, _pp_loss_fn, opt, k, mesh,
+                              data_axis="data", skip_nonfinite=True)
+    state, aux = step(pp_init(stages, opt), batch)
+    assert int(aux["skipped"]) == 1 and int(aux["good_count"]) == k - 1
+
+    _, ref_params = _pp_masked_reference(stages, batch, opt, k, {2})
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        jax.device_get(state.params), jax.device_get(ref_params),
+    )
+
+
+def test_pp_guard_parity_with_zero_faults():
+    """Guard on vs off, no faults: same update (ULP-level tolerance — the
+    masked-sum loss lowers slightly differently than jnp.mean)."""
+    n_stages, k = 2, 4
+    mesh = make_mesh(pipe=n_stages, devices=jax.devices()[:n_stages])
+    stages = _pp_stages(17, n_stages)
+    batch = _pp_batch(18, k)
+    opt = sgd(0.5)
+
+    on = make_pp_train_step(_pp_stage_fn, _pp_loss_fn, opt, k, mesh,
+                            skip_nonfinite=True)
+    off = make_pp_train_step(_pp_stage_fn, _pp_loss_fn, opt, k, mesh)
+    s_on, aux_on = on(pp_init(stages, opt), batch)
+    s_off, aux_off = off(pp_init(stages, opt), batch)
+    assert int(aux_on["skipped"]) == 0
+    np.testing.assert_allclose(float(aux_on["loss"]), float(aux_off["loss"]),
+                               rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        jax.device_get(s_on.params), jax.device_get(s_off.params),
+    )
+
+
+# -- the sparse-embed path ----------------------------------------------------
+
+V, H, S_EMB = 16, 4, 5
+
+
+def _emb_setup(seed=0):
+    rng = np.random.default_rng(seed)
+    params = {
+        "emb": {"table": jnp.asarray(rng.normal(scale=0.3, size=(V, H)),
+                                     jnp.float32)},
+        "w": jnp.asarray(rng.normal(scale=0.3, size=(H, 1)), jnp.float32),
+    }
+
+    def loss_with_rows(p, rows, batch):
+        # rows: [B, S, H] gathered word rows; "scale" is the float leaf
+        # fault injection poisons
+        feat = rows.mean(axis=1) * batch["scale"][:, None]
+        pred = feat @ p["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    hooks = SparseEmbedHooks(table_path=("emb", "table"), ids_key="ids",
+                             loss_with_rows=loss_with_rows)
+
+    def dense_loss(p, batch):
+        rows = jnp.take(p["emb"]["table"], batch["ids"], axis=0)
+        return loss_with_rows(p, rows, batch)
+
+    def batch(k=K, bad_micros=()):
+        ids = rng.integers(0, V, size=(k * B, S_EMB)).astype(np.int32)
+        scale = np.ones((k * B,), np.float32)
+        y = rng.normal(size=(k * B, 1)).astype(np.float32)
+        stacked = gt.stack_micro_batches(
+            {"ids": ids, "scale": scale, "y": y}, k
+        )
+        for j in bad_micros:
+            stacked["scale"][j] = np.nan
+        return stacked
+
+    return params, hooks, dense_loss, batch
+
+
+def test_sparse_embed_guard_parity_with_zero_faults():
+    """skip on vs off, zero faults: bitwise identical on the sparse path."""
+    params, hooks, _, make_batch = _emb_setup(31)
+    opt = adam(1e-2)
+    b = make_batch()
+    cfg_on = acc.GradAccumConfig(num_micro_batches=K, skip_nonfinite=True)
+    cfg_off = acc.GradAccumConfig(num_micro_batches=K)
+    on = jax.jit(accumulate_scan_sparse_embed(hooks, opt, cfg_on))
+    off = jax.jit(accumulate_scan_sparse_embed(hooks, opt, cfg_off))
+    rng_key = jax.random.PRNGKey(4)
+    s_on, aux_on = on(acc.scan_init(params, opt), b, rng_key)
+    s_off, aux_off = off(acc.scan_init(params, opt), b, rng_key)
+    assert int(aux_on["skipped"]) == 0
+    _assert_trees_equal(s_on.params, s_off.params)
+    _assert_trees_equal(s_on.opt_state, s_off.opt_state)
+
+
+def test_sparse_embed_skips_bad_micro_and_matches_guarded_dense():
+    """A poisoned micro-batch on the sparse path: skipped (row cotangents
+    zeroed before the scatter) and the update matches the guarded DENSE
+    path on the same batch — the guard preserves the sparse/dense parity
+    contract."""
+    params, hooks, dense_loss, make_batch = _emb_setup(33)
+    opt = adam(1e-2)
+    b = make_batch(bad_micros=(0,))
+    cfg = acc.GradAccumConfig(num_micro_batches=K, skip_nonfinite=True)
+    sparse = jax.jit(accumulate_scan_sparse_embed(hooks, opt, cfg))
+    dense = jax.jit(acc.accumulate_scan(dense_loss, opt, cfg, needs_rng=True))
+    rng_key = jax.random.PRNGKey(4)
+    s_sp, aux_sp = sparse(acc.scan_init(params, opt), b, rng_key)
+    s_dn, aux_dn = dense(acc.scan_init(params, opt), b, rng_key)
+    assert int(aux_sp["skipped"]) == 1 == int(aux_dn["skipped"])
+    assert int(aux_sp["good_count"]) == 1
+    jax.tree.map(
+        lambda a, c: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(c), rtol=1e-5, atol=1e-7
+        ),
+        jax.device_get(s_sp.params), jax.device_get(s_dn.params),
+    )
+    for leaf in jax.tree.leaves(jax.device_get(s_sp.params)):
+        assert np.all(np.isfinite(leaf))
+
+
+def test_sparse_embed_all_bad_window_is_bitwise_noop():
+    params, hooks, _, make_batch = _emb_setup(35)
+    opt = adam(1e-2)
+    b = make_batch(bad_micros=tuple(range(K)))
+    cfg = acc.GradAccumConfig(num_micro_batches=K, skip_nonfinite=True)
+    step = jax.jit(accumulate_scan_sparse_embed(hooks, opt, cfg))
+    state, aux = step(acc.scan_init(params, opt), b, jax.random.PRNGKey(4))
+    assert int(aux["skipped"]) == K and int(aux["good_count"]) == 0
+    ref = acc.scan_init(params, opt)
+    _assert_trees_equal(state.params, ref.params)
+    _assert_trees_equal(state.opt_state, ref.opt_state)
+
+
+# -- guard overhead micro-bench (slow lane) -----------------------------------
+
+
+@pytest.mark.slow
+def test_guard_overhead_bench_records_artifact():
+    """Measure the in-graph guard's step-time overhead (scan mode, tiny
+    MLP, CPU) and record it into BENCH_resilience.json with an acceptance
+    block bench_trend.py can gate on. The bar is deliberately loose — CPU
+    timing noise — the artifact's job is the trend, the gate only catches
+    a blowup."""
+    import time
+
+    rng = np.random.default_rng(7)
+    params = {
+        "w1": jnp.asarray(rng.normal(scale=0.3, size=(64, 64)), jnp.float32),
+        "w2": jnp.asarray(rng.normal(scale=0.3, size=(64, 1)), jnp.float32),
+    }
+
+    def loss_fn(p, batch):
+        h = jnp.tanh(batch["x"] @ p["w1"])
+        return jnp.mean((h @ p["w2"] - batch["y"]) ** 2)
+
+    k = 8
+    batch = gt.stack_micro_batches(
+        {"x": rng.normal(size=(k * 32, 64)).astype(np.float32),
+         "y": rng.normal(size=(k * 32, 1)).astype(np.float32)}, k
+    )
+    opt = adam(1e-3)
+
+    def time_step(skip):
+        cfg = acc.GradAccumConfig(num_micro_batches=k, skip_nonfinite=skip)
+        step = jax.jit(acc.accumulate_scan(loss_fn, opt, cfg))
+        state = acc.scan_init(params, opt)
+        state, aux = step(state, batch)  # compile
+        jax.block_until_ready(aux["loss"])
+        times = []
+        for _ in range(30):
+            t0 = time.perf_counter()
+            state, aux = step(state, batch)
+            jax.block_until_ready(aux["loss"])
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times))
+
+    t_off = time_step(False)
+    t_on = time_step(True)
+    ratio = t_on / t_off
+    required = "guarded step-time <= 2.5x unguarded (CPU, tiny MLP)"
+    passed = ratio <= 2.5
+    artifact = {
+        "bench": "skip_nonfinite guard overhead (scan mode, K=8, CPU)",
+        "step_time_unguarded_s": t_off,
+        "step_time_guarded_s": t_on,
+        "overhead_ratio": ratio,
+        "acceptance": {"required": required, "passed": passed},
+    }
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_resilience.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    assert passed, f"guard overhead ratio {ratio:.2f} exceeds the 2.5x bar"

@@ -19,6 +19,7 @@ from gradaccum_tpu.parallel.ring_attention import (
     make_ring_attention_fn,
     ring_attention,
 )
+from gradaccum_tpu.utils import compat
 
 B, H, S, D = 2, 4, 32, 8
 
@@ -63,7 +64,7 @@ def test_ring_matches_dense_on_seq_mesh(rng, n_seq):
 
     mesh = make_mesh(seq=n_seq, devices=jax.devices()[:n_seq])
     ring = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             lambda *args: ring_attention(*args, axis="seq"),
             mesh=mesh,
             in_specs=(P(None, None, "seq"), P(None, None, "seq"),
@@ -79,7 +80,7 @@ def test_ring_no_mask(rng):
     q, k, v, _ = _qkv_mask(rng)
     mesh = make_mesh(seq=4, devices=jax.devices()[:4])
     ring = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             lambda a, b, c: ring_attention(a, b, c, None, axis="seq"),
             mesh=mesh,
             in_specs=(P(None, None, "seq"),) * 3,
@@ -117,7 +118,7 @@ def test_ring_attention_grads_flow(rng):
     mesh = make_mesh(seq=4, devices=jax.devices()[:4])
 
     def ring_loss(q, k, v, mask):
-        f = jax.shard_map(
+        f = compat.shard_map(
             lambda *a: ring_attention(*a, axis="seq"),
             mesh=mesh,
             in_specs=(P(None, None, "seq"), P(None, None, "seq"),

@@ -420,9 +420,15 @@ def test_server_cancel_midstream_threadsafe(tiny_lm):
     with ServingServer(engine) as srv:
         handle = srv.submit(prompt, 40)
         next(iter(handle))  # at least one token: the request is running
-        assert srv.cancel(handle.request_id) is True
+        cancelled = srv.cancel(handle.request_id)
         tokens, reason = handle.result(timeout=60)
-        assert reason == "cancelled" and len(tokens) >= 1
+        if cancelled:
+            assert reason == "cancelled" and len(tokens) >= 1
+        else:
+            # rare scheduler-delay race: the loop thread finished all 40
+            # tokens before cancel landed — then the request must have
+            # completed CLEANLY (anything else is a real cancel bug)
+            assert reason in ("eos", "length") and len(tokens) >= 1
         assert srv.cancel(handle.request_id) is False  # already gone
     assert engine.pool.allocated_blocks == 0
     assert engine.pool.unreserved_blocks == engine.pool.num_blocks

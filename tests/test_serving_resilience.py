@@ -230,6 +230,59 @@ def test_watchdog_unblocks_stalled_clients(tiny_lm):
         server.stop()
 
 
+def test_slow_tick_fault_trips_watchdog(tiny_lm):
+    """The seeded ``slow_tick`` fault kind — a wedged-but-alive dispatch
+    stalled INSIDE the tick, at the same fault point the crash kinds use —
+    must trip the serving watchdog exactly like a genuinely hung tick:
+    pending handles fail fast with TimeoutError, stop() re-raises."""
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    # warm the tick programs first: the watchdog budget below is tighter
+    # than jit compile time, and a compile-stall is not what this gates
+    warm = ServingServer(engine).start()
+    warm.submit(_prompts(cfg, 1, seed=3)[0], 2).result(timeout=60)
+    warm.stop()
+    inj = FaultInjector(FaultSchedule([
+        FaultSpec(faults.MID_DECODE_TICK, at=None,
+                  kind=faults.KIND_SLOW_TICK, delay=1.0)
+    ]))
+    with faults.installed(inj):
+        server = ServingServer(engine, watchdog_timeout=0.15).start()
+        handle = server.submit(_prompts(cfg, 1)[0], 4)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError) as err:
+            handle.result(timeout=30)
+        assert isinstance(err.value.__cause__, TimeoutError)
+        assert time.monotonic() - t0 < 1.0  # failed before the stall ended
+        with pytest.raises(RuntimeError, match="engine failed"):
+            server.stop()
+    assert inj.fired and inj.fired[0][2] == faults.KIND_SLOW_TICK
+
+
+def test_slow_tick_under_watchdog_budget_is_harmless(tiny_lm):
+    """A slow tick SHORTER than the watchdog budget must not false-positive:
+    the request completes normally and parity holds."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    inj = FaultInjector(FaultSchedule([
+        FaultSpec(faults.MID_DECODE_TICK, at=1,
+                  kind=faults.KIND_SLOW_TICK, delay=0.05)
+    ]))
+    prompt = _prompts(cfg, 1)[0]
+    with faults.installed(inj):
+        server = ServingServer(engine, watchdog_timeout=5.0).start()
+        tokens, reason = server.submit(prompt, 4).result(timeout=60)
+        server.stop()
+    assert inj.fired == [(faults.MID_DECODE_TICK, 1, faults.KIND_SLOW_TICK)]
+    want = np.asarray(generate_cached(params, cfg, prompt, 4))
+    np.testing.assert_array_equal(np.asarray(tokens), want[0, prompt.size:])
+
+
 def test_stream_handle_error_propagation():
     from gradaccum_tpu.serving import StreamHandle
 

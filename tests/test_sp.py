@@ -17,6 +17,7 @@ from gradaccum_tpu.ops.accumulation import scan_init
 from gradaccum_tpu.parallel.mesh import make_mesh
 from gradaccum_tpu.parallel.ring_attention import make_ring_attention_fn
 from gradaccum_tpu.parallel.sp import make_dp_sp_train_step
+from gradaccum_tpu.utils import compat
 
 K = 2
 B = 4  # global batch per micro-step
@@ -137,7 +138,7 @@ def test_sp_forward_matches_dense(rng):
         "label": P(),
     }
     predict = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             lambda p, b: sp_bundle.predict(p, b)["logits"],
             mesh=mesh, in_specs=(P(), seq_spec), out_specs=P(),
         )

@@ -13,6 +13,7 @@ from gradaccum_tpu.ops.sparse_embed import (
     accumulate_scan_sparse_embed,
     _get_path,
 )
+from gradaccum_tpu.utils import compat
 
 K, MICRO, SEQ = 4, 2, 16
 
@@ -128,7 +129,7 @@ def test_sparse_with_dp_axis(rng):
     sparse_inner = accumulate_scan_sparse_embed(
         bundle.sparse_embed, opt, accfg._replace(axis_name="data")
     )
-    sparse_step = jax.jit(jax.shard_map(
+    sparse_step = jax.jit(compat.shard_map(
         sparse_inner, mesh=mesh,
         in_specs=(P(), P(None, "data"), P()), out_specs=(P(), P()),
     ))

@@ -1,0 +1,242 @@
+"""One-command seeded chaos run over train + serve.
+
+Draws a random fault schedule from ``--seed`` (crash mid-train, an
+overflow storm, an IO error inside a checkpoint write, a decode-tick crash
+and a slow tick on the serving side), runs a small training job to
+completion THROUGH the faults — resuming from the newest checkpoint after
+every injected kill, exactly like an operator would — then runs a serving
+burst through its own faults. Asserts the end state is healthy:
+
+- training reached ``max_steps`` with a non-empty, restorable final
+  checkpoint and all-finite params;
+- the loss-scale series halved and regrew through the storm;
+- every serving request completed with greedy parity vs solo decode.
+
+Everything is deterministic under the seed (same seed, same chaos, same
+trajectory). Writes ``BENCH_chaos.json`` with an acceptance block that
+``tools/bench_trend.py`` aggregates, and exits 0 on PASS — wired as the
+``chaos``-marked slow test in tests/test_chaos.py.
+
+Usage: python tools/chaos_smoke.py [--seed N] [--json PATH]
+"""
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _train_chaos(seed: int, work_dir: str, log):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.estimator import checkpoint as ckpt_lib
+    from gradaccum_tpu.estimator.config import RunConfig
+    from gradaccum_tpu.estimator.estimator import Estimator, ModelBundle
+    from gradaccum_tpu.estimator.metrics import mean_absolute_error
+    from gradaccum_tpu.ops.loss_scale import LossScaleConfig
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.resilience.faults import (
+        FaultInjector,
+        FaultSchedule,
+        FaultSpec,
+    )
+
+    K, n_steps = 4, 48
+    rng = np.random.default_rng(seed)
+
+    def init(prng, sample):
+        del prng, sample
+        return {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}
+
+    def loss(params, batch):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    bundle = ModelBundle(
+        init=init, loss=loss,
+        predict=lambda p, b: {"predictions": b["x"] @ p["w"] + p["b"]},
+        eval_metrics={"mae": mean_absolute_error(label_key="y")},
+    )
+
+    data_rng = np.random.default_rng(seed + 1)
+    data = []
+    for _ in range(n_steps):
+        x = data_rng.normal(size=(8, 3)).astype(np.float32)
+        y = (x @ np.asarray([[1.0], [-2.0], [0.5]], np.float32)).astype(
+            np.float32
+        )
+        data.append({"x": x, "y": y})
+
+    # the seeded chaos plan: a kill, a storm, a flaky disk — all at once
+    crash_at = int(rng.integers(10, 30))
+    storm = FaultSchedule.overflow_storm(
+        seed, start_range=(30, 36), length_range=(K, 2 * K)
+    ).specs[0]
+    specs = [
+        FaultSpec(faults.POST_TRAIN_STEP, at=crash_at),
+        storm,
+        FaultSpec(faults.MID_CKPT_WRITE, at=None,
+                  kind=faults.KIND_IO_ERROR, count=2),
+    ]
+    log(f"[chaos/train] plan: kill@{crash_at}, storm@{storm.at}"
+        f"x{storm.span}, 2 ckpt IO errors")
+
+    def estimator():
+        return Estimator(
+            bundle, gt.ops.sgd(0.05),
+            gt.GradAccumConfig(
+                num_micro_batches=K, first_step_quirk=False,
+                skip_nonfinite=True, normalize_by_good_count=True,
+                loss_scale=LossScaleConfig(init_scale=16.0, growth_interval=2),
+            ),
+            RunConfig(model_dir=work_dir, save_checkpoints_steps=6,
+                      log_step_count_steps=1000),
+            mode="streaming",
+        )
+
+    injector = FaultInjector(FaultSchedule(specs))
+    scale_series = []
+    crashes = 0
+    offset = 0
+    with faults.installed(injector):
+        for attempt in range(6):
+            est = estimator()
+            try:
+                state = est.train(data[offset:], max_steps=n_steps)
+                scale_series += [v for _, v in est.loss_scale_series]
+                break
+            except faults.InjectedCrash as e:
+                crashes += 1
+                scale_series += [v for _, v in est.loss_scale_series]
+                latest = ckpt_lib.latest_checkpoint(work_dir)
+                assert latest is not None, "crash before any checkpoint"
+                offset = latest[0]
+                log(f"[chaos/train] injected kill ({e}); resuming from "
+                    f"checkpoint step={offset}")
+        else:
+            raise AssertionError("did not finish within the attempt budget")
+
+    assert crashes >= 1, "the seeded kill never fired"
+    assert int(jax.device_get(state.step)) == n_steps
+    ckpt_step, ckpt_path = ckpt_lib.latest_checkpoint(work_dir)
+    assert ckpt_step == n_steps and os.path.getsize(ckpt_path) > 0, \
+        "final checkpoint missing or empty"
+    restored = ckpt_lib.restore(work_dir, jax.device_get(state))
+    for leaf in jax.tree.leaves(restored):
+        assert np.all(np.isfinite(np.asarray(leaf))), "non-finite state"
+    halves = [i for i in range(1, len(scale_series))
+              if scale_series[i] < scale_series[i - 1]]
+    grows = [i for i in range(1, len(scale_series))
+             if scale_series[i] > scale_series[i - 1]]
+    assert halves and grows, f"loss scale never cycled: {scale_series}"
+    fired = [(p, i, k) for p, i, k in injector.fired]
+    log(f"[chaos/train] PASS: {crashes} kill(s) survived, "
+        f"{len(fired)} faults fired, final ckpt step={ckpt_step}")
+    return {"crashes": crashes, "faults_fired": fired,
+            "final_step": int(jax.device_get(state.step))}
+
+
+def _serve_chaos(seed: int, log):
+    import jax
+    import numpy as np
+
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.resilience.faults import (
+        FaultInjector,
+        FaultSchedule,
+        FaultSpec,
+    )
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    rng = np.random.default_rng(seed + 2)
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(jax.random.PRNGKey(0),
+                         {"input_ids": np.zeros((1, 8), np.int32)})
+    engine = Engine(params, cfg, num_slots=3, max_len=32)
+    prompts = [
+        rng.integers(0, cfg.vocab_size,
+                     size=(int(rng.integers(1, 8)),)).astype(np.int32)
+        for _ in range(6)
+    ]
+
+    crash_tick = int(rng.integers(1, 5))
+    specs = [
+        FaultSpec(faults.MID_DECODE_TICK, at=crash_tick),
+        FaultSpec(faults.MID_DECODE_TICK, at=crash_tick + 3,
+                  kind=faults.KIND_SLOW_TICK, delay=0.05),
+    ]
+    log(f"[chaos/serve] plan: tick crash@{crash_tick}, "
+        f"slow tick@{crash_tick + 3}")
+    injector = FaultInjector(FaultSchedule(specs))
+    with faults.installed(injector):
+        server = ServingServer(engine, max_requeues=2).start()
+        handles = [server.submit(p, 5) for p in prompts]
+        results = [h.result(timeout=120) for h in handles]
+        server.stop()  # must not raise: the engine recovered
+
+    assert any(k == faults.KIND_CRASH for _, _, k in injector.fired), \
+        "the seeded tick crash never fired"
+    for prompt, (tokens, reason) in zip(prompts, results):
+        assert reason in ("eos", "length"), reason
+        want = np.asarray(generate_cached(params, cfg, prompt, 5))
+        np.testing.assert_array_equal(
+            np.asarray(tokens), want[0, prompt.size:]
+        )
+    assert engine.idle
+    log(f"[chaos/serve] PASS: {len(results)} requests completed with "
+        f"greedy parity through {len(injector.fired)} fault(s)")
+    return {"requests": len(results),
+            "faults_fired": list(injector.fired)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=0xC8A05)
+    ap.add_argument("--json", default=None,
+                    help="artifact path (default: <repo>/BENCH_chaos.json)")
+    args = ap.parse_args(argv)
+
+    log = print
+    import tempfile
+
+    required = ("seeded chaos (train kill+storm+ckpt IO, serve tick "
+                "crash+slow tick): clean resume, non-empty final "
+                "checkpoint, greedy serving parity")
+    passed = False
+    detail = {}
+    try:
+        with tempfile.TemporaryDirectory() as work:
+            detail["train"] = _train_chaos(args.seed, work, log)
+        detail["serve"] = _serve_chaos(args.seed, log)
+        passed = True
+    except AssertionError as e:
+        log(f"[chaos] FAIL: {e}")
+
+    artifact = {
+        "bench": "seeded chaos smoke (train + serve, CPU)",
+        "seed": args.seed,
+        "detail": detail,
+        "acceptance": {"required": required, "passed": passed},
+    }
+    out = args.json or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_chaos.json",
+    )
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1, default=str)
+        f.write("\n")
+    log(f"[chaos] {'PASS' if passed else 'FAIL'}; wrote {out}")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
