@@ -18,6 +18,7 @@ from gradaccum_tpu import (
     data,
     estimator,
     models,
+    obs,
     ops,
     parallel,
     resilience,

@@ -269,6 +269,10 @@ class Estimator:
         # step a multi-host drain consensus stopped this trainer at (None
         # when no drain happened in the last train() call)
         self.drained_at_step = None
+        # obs: lazy metrics registry (bridging to the EventWriter) and
+        # flight recorder (crash / drain postmortems under model_dir)
+        self._registry = None
+        self._flight = None
 
     def _ckpt_save(self, state, step_no):
         """Route through the async writer when configured — training only
@@ -307,6 +311,39 @@ class Estimator:
 
             self._res.events = EventWriter(self.config.model_dir)
         return self._res.events
+
+    @property
+    def registry(self):
+        """The run's :class:`~gradaccum_tpu.obs.metrics.MetricsRegistry`:
+        every scalar the harness publishes (loss, guard skips, loss scale,
+        eval metrics) is recorded here AND streamed to the EventWriter —
+        one API for dashboards, crash dumps, and Prometheus scrapes."""
+        if self._registry is None:
+            from gradaccum_tpu.obs.metrics import MetricsRegistry
+
+            self._registry = MetricsRegistry(event_writer=self.events)
+        else:
+            # close() + resume recreates the EventWriter; re-bind so the
+            # bridge streams into the live one, never a closed instance
+            # whose sub-writers nothing would ever flush
+            self._registry.bind_writer(self.events)
+        return self._registry
+
+    def _flight_dump(self, reason: str):
+        """Dump the obs ring under ``model_dir/flightrec`` (no-op without
+        a model_dir or with obs disabled). Never raises — failure paths
+        call this while an exception is already the story."""
+        if not self.config.model_dir:
+            return None
+        try:
+            if self._flight is None:
+                from gradaccum_tpu.obs.flight import FlightRecorder
+
+                self._flight = FlightRecorder(self.config.model_dir,
+                                              registry=self.registry)
+            return self._flight.dump(reason)
+        except Exception:  # noqa: BLE001 — postmortem is best-effort
+            return None
 
     # -- state ----------------------------------------------------------
 
@@ -595,11 +632,18 @@ class Estimator:
         micro_size = None
         last_saved = None
 
+        from gradaccum_tpu.obs import trace as obs_trace
         from gradaccum_tpu.utils.profiling import StepWindowProfiler
 
         profiler = StepWindowProfiler(
             cfg.profile_dir, cfg.profile_start_step, cfg.profile_num_steps
         )
+        tracer = obs_trace.get_tracer()
+        # streaming mode applies when step % K == phase (the reference's
+        # optimization.py:91 condition, quirk included); scan mode fuses a
+        # whole accumulate+apply K-cycle into every host step
+        k_accum = self.accum.num_micro_batches
+        apply_phase = 0 if self.accum.first_step_quirk else k_accum - 1
 
         def flush_loss_rows():
             # fetch pending device scalars and clear the list, so a long run
@@ -610,29 +654,43 @@ class Estimator:
                 )
                 loss_rows.clear()
             if skip_rows:
-                self.nonfinite_skips += int(
-                    sum(int(v) for v in jax.device_get(skip_rows))
-                )
+                flushed = int(sum(int(v) for v in jax.device_get(skip_rows)))
+                self.nonfinite_skips += flushed
                 skip_rows.clear()
+                if flushed and tracer.enabled:
+                    # the guard verdict on the timeline: how many
+                    # micro-batches this window zero-substituted
+                    tracer.event("train/nonfinite_skip", cat="train",
+                                 step=step_no, skipped=flushed,
+                                 total=self.nonfinite_skips)
                 if cfg.model_dir:
                     # cumulative count: a flat line means a healthy run
-                    self.events.scalar(
-                        "nonfinite_skips", self.nonfinite_skips, step_no
+                    self.registry.publish(
+                        {"nonfinite_skips": self.nonfinite_skips}, step_no
                     )
             if scale_rows:
                 rows = [(s, float(v)) for s, v in jax.device_get(scale_rows)]
                 scale_rows.clear()
                 self.loss_scale_series.extend(rows)
+                if tracer.enabled:
+                    for s, v in rows:
+                        tracer.event("train/loss_scale", cat="train",
+                                     step=s, scale=v)
                 if cfg.model_dir:
                     for s, v in rows:
-                        self.events.scalar("loss_scale", v, s)
+                        self.registry.publish({"loss_scale": v}, s)
             if good_rows:
                 rows = [(s, int(v)) for s, v in jax.device_get(good_rows)]
                 good_rows.clear()
                 self.good_count_series.extend(rows)
+                if tracer.enabled:
+                    for s, v in rows:
+                        if v < k_accum:  # a clean window is not an event
+                            tracer.event("train/guard_verdict", cat="train",
+                                         step=s, good=v, window=k_accum)
                 if cfg.model_dir:
                     for s, v in rows:
-                        self.events.scalar("good_count", v, s)
+                        self.registry.publish({"good_count": v}, s)
 
         def flush(save_ckpt: bool):
             nonlocal last_saved
@@ -674,6 +732,10 @@ class Estimator:
                     if final_save:
                         preemption.acknowledge()
                     self.drained_at_step = step_no
+                    if tracer.enabled:
+                        tracer.event("preemption/drain", cat="resilience",
+                                     step=step_no, target=drain_target)
+                    self._flight_dump("sigterm-drain")
                     print(f"[train] preemption requested; stopping at "
                           f"step={step_no}"
                           + (" after final checkpoint" if final_save else ""))
@@ -692,7 +754,18 @@ class Estimator:
                     batch = faults.corrupt_batch(batch, kind)
                 # observe pre-dispatch: the window always traces >=1 step
                 profiler.observe(step_no)
-                state, aux = step_fn(state, *self._prep_batch(batch, step_no))
+                if tracer.enabled:
+                    branch = ("scan-cycle" if self.mode == "scan" else
+                              "apply" if step_no % k_accum == apply_phase
+                              else "accumulate")
+                    step_span = tracer.span("train/step", cat="train",
+                                            step=step_no, branch=branch)
+                else:
+                    step_span = obs_trace.NULL.span("")
+                with step_span:
+                    state, aux = step_fn(
+                        state, *self._prep_batch(batch, step_no)
+                    )
                 step_no += k
                 faults.fire(faults.POST_TRAIN_STEP, step_no)
                 if "skipped" in aux:
@@ -733,7 +806,9 @@ class Estimator:
             # a crash mid-train must still land the last checkpoint: drain
             # and close the async writer (and the event files). close() is
             # repeat-safe and later API calls recreate both lazily, so a
-            # caller that catches and resumes loses nothing.
+            # caller that catches and resumes loses nothing. The flight
+            # recorder dumps first — the crash ships its own postmortem.
+            self._flight_dump("crash")
             try:
                 self.close()
             except Exception:
@@ -790,6 +865,10 @@ class Estimator:
         }
         print(f"[{name}] " + " ".join(f"{k}={v:.5f}" for k, v in results.items()))
         if self.config.model_dir:
+            # recorded as registry gauges (under "<name>/<metric>") and
+            # streamed to the eval EventWriter subdir exactly as before
+            for key, value in results.items():
+                self.registry.gauge(f"{name}/{key}").set(value, step=at_step)
             self.events.scalars(results, at_step, subdir=name)
             self.events.flush()
         results["_num_batches"] = n_batches
@@ -1023,5 +1102,5 @@ class Estimator:
             for step, loss in rows:
                 f.write(f"{step},{loss}\n")
         for step, loss in rows:
-            self.events.scalar("loss", loss, step)
+            self.registry.publish({"loss": loss}, step)
         self.events.flush()

@@ -1,0 +1,51 @@
+"""Unified observability: structured spans, a metrics registry, and a
+crash flight recorder — one correlated timeline across train, serve and
+resilience.
+
+Three pieces, all host-side and hot-path-safe (no device syncs; a strict
+no-op under ``GRADACCUM_OBS=0``):
+
+- ``trace`` — span tracer emitting Chrome/Perfetto trace-event JSON with
+  logical (``args.seq``) and clock (``ts``) timestamps; deterministic mode
+  produces byte-identical traces under the simulation clock.
+- ``metrics`` — counters/gauges/histograms with JSON snapshots and
+  Prometheus text export, bridging to the TensorBoard ``EventWriter``.
+- ``flight`` — a bounded ring of recent events dumped to
+  ``model_dir/flightrec/`` on crash, SIGTERM drain, or watchdog fire.
+
+Render a run summary from traces/dumps with ``tools/obs_report.py``;
+enabled-vs-disabled overhead is measured by ``tools/bench_obs.py``
+(BENCH_obs.json).
+"""
+
+from gradaccum_tpu.obs.flight import FlightRecorder
+from gradaccum_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from gradaccum_tpu.obs.trace import (
+    NULL,
+    NullTracer,
+    Tracer,
+    get_tracer,
+    installed,
+    obs_enabled,
+    set_tracer,
+)
+
+__all__ = [
+    "Counter",
+    "FlightRecorder",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NULL",
+    "NullTracer",
+    "Tracer",
+    "get_tracer",
+    "installed",
+    "obs_enabled",
+    "set_tracer",
+]

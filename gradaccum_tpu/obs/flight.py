@@ -1,0 +1,110 @@
+"""Flight recorder: every failure ships its own postmortem.
+
+The tracer already keeps a bounded ring of recent spans/events (see
+``obs/trace.py``); the :class:`FlightRecorder` dumps that ring — plus a
+metrics snapshot — to ``<out_dir>/flightrec/`` when something goes wrong:
+
+- the Estimator's train loop dumps on any crash out of the step loop and
+  on a SIGTERM/preemption drain;
+- the serving server dumps on every recovered engine fault, on give-up,
+  and when the tick watchdog fires;
+- ``tools/chaos_smoke.py`` dumps at the end of each chaos phase and
+  asserts every injected fault appears in the ring.
+
+Dump files are numbered (``dump-0001-<reason>.json``) by scanning the
+directory, so repeated crashes — or a resumed process crashing again into
+the same ``model_dir`` — never overwrite an earlier postmortem.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import List, Optional, Tuple
+
+from gradaccum_tpu.obs import trace as obs_trace
+
+_SAFE_RE = re.compile(r"[^a-zA-Z0-9._-]+")
+
+
+class FlightRecorder:
+    """Dumps the tracer ring (+ optional registry snapshot) on demand.
+
+    ``tracer=None`` re-resolves the global tracer AT DUMP TIME, so a
+    recorder built before ``set_tracer`` still captures the ring that was
+    actually recording. A disabled tracer or missing ``out_dir`` makes
+    ``dump`` a no-op returning None — failure paths can call it
+    unconditionally.
+    """
+
+    def __init__(self, out_dir: Optional[str], tracer=None, registry=None,
+                 subdir: str = "flightrec"):
+        self.out_dir = out_dir
+        self._tracer = tracer
+        self.registry = registry
+        self.subdir = subdir
+
+    @property
+    def tracer(self):
+        return obs_trace.resolve(self._tracer)
+
+    def dump(self, reason: str, extra: Optional[dict] = None) -> Optional[str]:
+        """Write one postmortem; returns its path (None when disabled)."""
+        tracer = self.tracer
+        if self.out_dir is None or not tracer.enabled:
+            return None
+        payload = {
+            "reason": reason,
+            "events": tracer.snapshot(),
+            "dropped_events": getattr(tracer, "dropped", 0),
+            "metrics": (self.registry.snapshot()
+                        if self.registry is not None else None),
+        }
+        if extra:
+            payload["extra"] = extra
+        d = os.path.join(self.out_dir, self.subdir)
+        os.makedirs(d, exist_ok=True)
+        safe = _SAFE_RE.sub("-", reason) or "dump"
+        n = 1
+        while True:
+            path = os.path.join(d, f"dump-{n:04d}-{safe}.json")
+            if not os.path.exists(path):
+                break
+            n += 1
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, sort_keys=True, separators=(",", ":"))
+            f.write("\n")
+        os.replace(tmp, path)  # a crash mid-dump never leaves a half file
+        return path
+
+
+# -- dump readers (chaos assertions, obs_report) ------------------------------
+
+
+def load_dump(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def list_dumps(out_dir: str, subdir: str = "flightrec") -> List[str]:
+    d = os.path.join(out_dir, subdir)
+    if not os.path.isdir(d):
+        return []
+    return sorted(
+        os.path.join(d, f) for f in os.listdir(d)
+        if f.startswith("dump-") and f.endswith(".json")
+    )
+
+
+def fault_events(events: List[dict]) -> List[Tuple[str, int, str]]:
+    """The injected-fault tuples recorded in a dump's event list — the
+    exact shape of ``FaultInjector.fired``, so chaos assertions are a set
+    comparison."""
+    out = []
+    for ev in events:
+        if ev.get("name") == "fault/injected":
+            a = ev.get("args", {})
+            out.append((a.get("point"), a.get("index"), a.get("kind")))
+    return out

@@ -1,0 +1,213 @@
+"""Metrics registry: counters / gauges / histograms behind one API.
+
+Before this module each subsystem rolled its own scalars —
+``serving/metrics.py`` wrote straight to the TensorBoard
+:class:`~gradaccum_tpu.estimator.events.EventWriter`, the Estimator's train
+loop scattered ``events.scalar`` calls, and nothing could answer "what are
+ALL the current numbers" without a TensorBoard reader. The registry is that
+single surface:
+
+- :class:`Counter` (monotonic), :class:`Gauge` (last value + step),
+  :class:`Histogram` (wraps :class:`~gradaccum_tpu.utils.timing.
+  LatencySeries`, so every percentile in the repo is computed one way).
+- ``snapshot()`` — one JSON-able dict of everything (the flight recorder
+  embeds it in crash dumps).
+- ``to_prometheus()`` — Prometheus text exposition (quantiles exported
+  summary-style), for scraping a serving host.
+- ``publish(scalars, step)`` — the EventWriter bridge: callers that used
+  to write scalars directly now publish through the registry, which
+  RECORDS them as gauges and still streams to TensorBoard, so existing
+  dashboards keep working.
+
+Everything is host-side ints/floats; nothing here touches a device.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, Optional
+
+from gradaccum_tpu.utils.timing import LatencySeries
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    n = _NAME_RE.sub("_", name)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return n
+
+
+class Counter:
+    """Monotonic counter. Single-writer per subsystem by design (the
+    serving engine is single-threaded; the train loop is one thread), so
+    ``inc`` stays a bare add on the hot path."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-set value, plus the step it was set at (if any)."""
+
+    __slots__ = ("name", "value", "step")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: Optional[float] = None
+        self.step: Optional[int] = None
+
+    def set(self, value: float, step: Optional[int] = None) -> None:
+        self.value = float(value)
+        if step is not None:
+            self.step = int(step)
+
+
+class Histogram:
+    """A sample distribution backed by a :class:`LatencySeries` — pass an
+    existing series to EXPOSE it (the serving metrics' TTFT series lands in
+    the registry without double bookkeeping)."""
+
+    __slots__ = ("name", "series")
+
+    def __init__(self, name: str, series: Optional[LatencySeries] = None):
+        self.name = name
+        self.series = series if series is not None else LatencySeries()
+
+    def observe(self, x: float) -> None:
+        self.series.add(x)
+
+    def summary(self) -> dict:
+        return self.series.summary()
+
+
+class MetricsRegistry:
+    """Named counters/gauges/histograms with JSON + Prometheus export and
+    an optional EventWriter bridge (``subdir`` scopes the TensorBoard
+    stream, e.g. ``"serving"``)."""
+
+    def __init__(self, event_writer=None, subdir: str = ""):
+        self._writer = event_writer
+        self._subdir = subdir
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    # -- instrument accessors (memoized; type conflicts are bugs) ---------
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                self._check_free(name, self._counters)
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                self._check_free(name, self._gauges)
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def histogram(self, name: str,
+                  series: Optional[LatencySeries] = None) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                self._check_free(name, self._histograms)
+                h = self._histograms[name] = Histogram(name, series)
+            elif series is not None and h.series is not series:
+                # a rebuilt owner (e.g. a new ServingMetrics on a shared
+                # registry) re-registers its live series; rebind so exports
+                # track the instance that is actually recording
+                h.series = series
+            return h
+
+    def _check_free(self, name: str, own: dict) -> None:
+        for kind in (self._counters, self._gauges, self._histograms):
+            if kind is not own and name in kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as a different type"
+                )
+
+    # -- the EventWriter bridge ------------------------------------------
+
+    def bind_writer(self, event_writer) -> None:
+        """Point the bridge at ``event_writer`` — owners whose writer can
+        be swapped out (the Estimator recreates it after ``close()`` +
+        resume) re-bind so publishes never stream into a detached writer
+        nothing will flush."""
+        self._writer = event_writer
+
+    def publish(self, scalars: Dict[str, float], step: int,
+                subdir: Optional[str] = None) -> None:
+        """Record ``scalars`` as gauges AND stream them to the EventWriter
+        (when one is attached and active) — the one call replacing direct
+        ``EventWriter.scalars`` use."""
+        for tag, value in scalars.items():
+            self.gauge(tag).set(value, step=step)
+        if self._writer is not None and self._writer.active:
+            self._writer.scalars(
+                scalars, step=step,
+                subdir=self._subdir if subdir is None else subdir,
+            )
+
+    def flush(self) -> None:
+        if self._writer is not None:
+            self._writer.flush()
+
+    # -- export -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Everything, as one JSON-able dict."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._histograms)
+        return {
+            "counters": {n: c.value for n, c in counters.items()},
+            "gauges": {
+                n: {"value": g.value, "step": g.step}
+                for n, g in gauges.items()
+            },
+            "histograms": {n: h.summary() for n, h in hists.items()},
+        }
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition. Histograms export summary-style
+        quantiles (p50/p90/p99) plus ``_count``."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._histograms)
+        lines = []
+        for n, c in counters.items():
+            pn = _prom_name(n)
+            lines.append(f"# TYPE {pn} counter")
+            lines.append(f"{pn} {c.value}")
+        for n, g in gauges.items():
+            if g.value is None:
+                continue
+            pn = _prom_name(n)
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {g.value}")
+        for n, h in hists.items():
+            pn = _prom_name(n)
+            s = h.summary()
+            lines.append(f"# TYPE {pn} summary")
+            for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+                if s.get(key) is not None:
+                    lines.append(f'{pn}{{quantile="{q}"}} {s[key]}')
+            lines.append(f"{pn}_count {s['count']}")
+        return "\n".join(lines) + ("\n" if lines else "")

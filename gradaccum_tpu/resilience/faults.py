@@ -197,6 +197,15 @@ class FaultInjector:
             if spec is None:
                 return None
             self.fired.append((point, index, spec.kind))
+        # injected faults land on the obs timeline too, so a flight dump
+        # or trace correlates every fault with its downstream effect spans
+        # (recover, requeue, resume) — the chaos smoke asserts exactly that
+        from gradaccum_tpu.obs import trace as obs_trace
+
+        tr = obs_trace.get_tracer()
+        if tr.enabled:
+            tr.event("fault/injected", cat="resilience", point=point,
+                     index=index, kind=spec.kind)
         if spec.kind == KIND_CRASH:
             raise InjectedCrash(point, index)
         if spec.kind == KIND_IO_ERROR:

@@ -276,8 +276,21 @@ class DrainConsensus:
             return False, int(step)
         try:
             if self.bus is not None:
-                return self.bus.exchange(self.host_id, req, int(step))
-            return self._kv_exchange(req, int(step))
+                drain, target = self.bus.exchange(self.host_id, req,
+                                                  int(step))
+            else:
+                drain, target = self._kv_exchange(req, int(step))
+            if drain:
+                # the drain VOTE lands on the obs timeline: which host saw
+                # the signal, what it voted, what the cluster agreed to
+                from gradaccum_tpu.obs import trace as obs_trace
+
+                tr = obs_trace.get_tracer()
+                if tr.enabled:
+                    tr.event("drain/vote", cat="resilience",
+                             host=self.host_id, requested=req,
+                             step=int(step), target=int(target))
+            return drain, target
         except Exception as e:  # noqa: BLE001 — any transport failure
             # a dead peer / lost coordinator must not strand this host in
             # its grace window: landing a local checkpoint beats hanging

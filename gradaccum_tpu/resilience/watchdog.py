@@ -28,11 +28,15 @@ class Watchdog:
         timeout: float,
         on_stall: Callable[[float], None],
         poll: Optional[float] = None,
+        tracer=None,
     ):
         if timeout <= 0:
             raise ValueError(f"timeout must be > 0, got {timeout}")
         self.timeout = timeout
         self._on_stall = on_stall
+        # obs tracer for the stall event (None = the process-global one,
+        # resolved at fire time so a tracer installed later still sees it)
+        self._tracer = tracer
         self._poll = poll if poll is not None else max(timeout / 4, 1e-3)
         self._armed_at: Optional[float] = None
         self._stop = threading.Event()
@@ -67,6 +71,13 @@ class Watchdog:
             elapsed = time.monotonic() - armed_at
             if elapsed > self.timeout:
                 self._armed_at = None  # one firing per stalled window
+                from gradaccum_tpu.obs import trace as obs_trace
+
+                tr = obs_trace.resolve(self._tracer)
+                if tr.enabled:
+                    tr.event("watchdog/stall", cat="resilience",
+                             elapsed_s=round(elapsed, 3),
+                             timeout_s=self.timeout)
                 try:
                     self._on_stall(elapsed)
                 except Exception:

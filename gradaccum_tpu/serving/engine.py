@@ -42,6 +42,7 @@ from gradaccum_tpu.models.gpt_decode import (
     prefill_paged,
     sample_token,
 )
+from gradaccum_tpu.obs import trace as obs_trace
 from gradaccum_tpu.resilience import faults
 from gradaccum_tpu.serving.cache_pool import (
     CachePool,
@@ -263,6 +264,7 @@ class Engine:
         profile_dir: Optional[str] = None,
         profile_start_tick: int = 0,
         profile_num_ticks: int = 0,
+        tracer=None,
     ):
         if top_k is not None and temperature <= 0:
             raise ValueError("top_k sampling needs temperature > 0 "
@@ -313,6 +315,19 @@ class Engine:
         self._head_match_memo: Optional[Tuple[int, int]] = None
         self.scheduler = scheduler or Scheduler()
         self.metrics = metrics or ServingMetrics()
+        # obs: request-lifecycle spans + tick spans land in this tracer —
+        # an injected one (the sim driver rewires a deterministic tracer's
+        # clock to the tick counter), or the process-global ring RESOLVED
+        # PER USE, so a tracer installed after engine construction still
+        # sees this engine's spans on the same timeline as fault events
+        self._tracer = tracer
+        if tracer is not None and \
+                getattr(self.scheduler, "_tracer", None) is None:
+            self.scheduler.tracer = tracer  # stall events, same timeline
+        # request_id -> tracer timestamp at submit (queue span) and at
+        # admission (decode/service span); only populated when tracing
+        self._req_submit_ts: Dict[int, float] = {}
+        self._req_admit_ts: Dict[int, float] = {}
         self.min_prefill_bucket = min_prefill_bucket
         self._profiler = StepWindowProfiler(
             profile_dir, profile_start_tick, profile_num_ticks
@@ -372,6 +387,17 @@ class Engine:
         self.status: Dict[int, str] = {}
 
     # -- introspection ----------------------------------------------------
+
+    @property
+    def tracer(self):
+        """The injected tracer, or the process-global one resolved NOW."""
+        return obs_trace.resolve(self._tracer)
+
+    @tracer.setter
+    def tracer(self, tracer) -> None:
+        """Inject (or with ``None``, un-pin) the engine's tracer —
+        bench_obs swaps tracers on one warmed engine between A/B legs."""
+        self._tracer = tracer
 
     @property
     def idle(self) -> bool:
@@ -456,20 +482,30 @@ class Engine:
                            else self._tick + int(deadline_ticks)),
             submit_tick=self._tick,
         )
+        tr = self.tracer
         try:
             self.scheduler.submit(req)
         except QueueFull as e:
             self.metrics.record_reject(rid)
+            bottleneck = self._bottleneck()
+            if tr.enabled:
+                tr.event("req/reject", cat="request", rid=rid,
+                         bottleneck=bottleneck)
             # backpressure names the scarce resource: operators grow slots
             # and KV blocks independently, so "which one ran out" is the
             # whole diagnosis
-            raise QueueFull(f"{e}; bottleneck: {self._bottleneck()}") from None
+            raise QueueFull(f"{e}; bottleneck: {bottleneck}") from None
         except Exception:
             self.metrics.record_reject(rid)
             raise
         self.results[rid] = []
         self.status[rid] = "queued"
         self.metrics.record_submit(rid)
+        if tr.enabled:
+            self._req_submit_ts[rid] = tr.now()
+            tr.event("req/submit", cat="request", rid=rid,
+                     prompt_len=int(prompt.size),
+                     max_new=int(max_new_tokens))
         return rid
 
     # -- the tick ---------------------------------------------------------
@@ -517,8 +553,25 @@ class Engine:
             jnp.dtype(self.cfg.dtype).itemsize
 
     def step(self) -> StepEvents:
-        """One engine tick: expire → admit/prefill → fused decode."""
+        """One engine tick: expire → admit/prefill → fused decode.
+
+        With tracing enabled the whole tick is one ``serve/tick`` span
+        (admission and decode dispatch are child spans; request lifecycle
+        transitions are instants) — with tracing disabled this delegates
+        straight to the untraced body, so the hot path pays one branch."""
+        tr = self.tracer
+        if not tr.enabled:
+            return self._step()
+        with tr.span("serve/tick", cat="serving", tick=self._tick) as sp:
+            events = self._step()
+            sp.set(admitted=len(events.admitted),
+                   emitted=len(events.emitted),
+                   finished=len(events.finished))
+            return events
+
+    def _step(self) -> StepEvents:
         t = self._tick
+        tr = self.tracer
         self._profiler.observe(t)
         emitted: List[Tuple[int, int]] = []
         finished: List[Tuple[int, str]] = []
@@ -528,6 +581,12 @@ class Engine:
             self.status[req.request_id] = "timeout"
             finished.append((req.request_id, "timeout"))
             self.metrics.record_finish(req.request_id, "timeout")
+            # pop unconditionally: the tracer can be swapped/disabled
+            # mid-flight, and a skipped pop would leak the rid forever
+            ts0 = self._req_submit_ts.pop(req.request_id, None)
+            if tr.enabled and ts0 is not None:
+                tr.complete("req/queue", ts0, cat="request",
+                            rid=req.request_id, outcome="timeout")
 
         fits = None
         if self.paged:
@@ -553,7 +612,12 @@ class Engine:
 
         reqs = self.scheduler.admit(self.pool.free_count, t, fits=fits)
         if reqs:
-            self._admit(reqs, emitted, finished, admitted)
+            if tr.enabled:
+                with tr.span("serve/prefill", cat="serving", tick=t,
+                             batch=len(reqs)):
+                    self._admit(reqs, emitted, finished, admitted)
+            else:
+                self._admit(reqs, emitted, finished, admitted)
         if self.scheduler.depth > 0 and self.pool.free_count == 0:
             self.scheduler.record_stall("no_free_slots")
 
@@ -564,42 +628,56 @@ class Engine:
         block = self._pick_block()
         active_now = self._active.copy()
         if active_now.any():
-            args = (
-                self.params, self.pool.k, self.pool.v, self.pool.lengths,
-                self._cur_tok, self._gen, self._rngs, jnp.asarray(active_now),
-            )
-            if self.paged:
-                # grow page tables BEFORE the dispatch to this tick's
-                # worst-case end position (never past the write limit, so
-                # the admission-time reservation always covers it)
-                for slot in np.nonzero(active_now)[0]:
-                    self.pool.alloc_to(
-                        int(slot),
-                        min(self._slot_len[slot] + block,
-                            self._slot_limit[slot]),
-                    )
-                out = self._tick_fns[block](
-                    *args, self.pool.page_table_device(), self._limit
-                )
+            if tr.enabled:
+                decode_args = dict(block=block, active=int(active_now.sum()))
+                if self.paged:
+                    decode_args["free_blocks"] = self.pool.free_blocks
+                decode_span = tr.span("serve/decode", cat="serving",
+                                      tick=t, **decode_args)
             else:
-                out = self._tick_fns[block](*args)
-            k, v, lengths, nxt, gen, toks = out
-            self.pool.set_arrays(k, v, lengths)
-            self._cur_tok, self._gen = nxt, gen
-            # host length mirror: paged writes clamp at the slot limit,
-            # fixed ones at max_len (out-of-bounds scatter drop)
-            self._slot_len[active_now] = np.minimum(
-                self._slot_len[active_now] + block,
-                self._slot_limit[active_now] if self.paged else self.max_len,
-            )
-            toks_host = np.asarray(jax.device_get(toks))  # [block, slots]
-            for d in range(toks_host.shape[0]):
-                for slot in np.nonzero(active_now)[0]:
-                    req = self._slot_req[slot]
-                    if req is None:  # retired earlier in this block
-                        continue
-                    self._emit(int(slot), req, int(toks_host[d, slot]),
-                               emitted, finished, first=False)
+                decode_span = obs_trace.NULL.span("")
+            # a with-block, not manual __enter__/__exit__: a decode-path
+            # exception must still land this span (error-tagged) in the
+            # ring, or the flight dump for that exact failure loses it
+            with decode_span:
+                args = (
+                    self.params, self.pool.k, self.pool.v, self.pool.lengths,
+                    self._cur_tok, self._gen, self._rngs,
+                    jnp.asarray(active_now),
+                )
+                if self.paged:
+                    # grow page tables BEFORE the dispatch to this tick's
+                    # worst-case end position (never past the write limit, so
+                    # the admission-time reservation always covers it)
+                    for slot in np.nonzero(active_now)[0]:
+                        self.pool.alloc_to(
+                            int(slot),
+                            min(self._slot_len[slot] + block,
+                                self._slot_limit[slot]),
+                        )
+                    out = self._tick_fns[block](
+                        *args, self.pool.page_table_device(), self._limit
+                    )
+                else:
+                    out = self._tick_fns[block](*args)
+                k, v, lengths, nxt, gen, toks = out
+                self.pool.set_arrays(k, v, lengths)
+                self._cur_tok, self._gen = nxt, gen
+                # host length mirror: paged writes clamp at the slot limit,
+                # fixed ones at max_len (out-of-bounds scatter drop)
+                self._slot_len[active_now] = np.minimum(
+                    self._slot_len[active_now] + block,
+                    self._slot_limit[active_now]
+                    if self.paged else self.max_len,
+                )
+                toks_host = np.asarray(jax.device_get(toks))  # [block, slots]
+                for d in range(toks_host.shape[0]):
+                    for slot in np.nonzero(active_now)[0]:
+                        req = self._slot_req[slot]
+                        if req is None:  # retired earlier in this block
+                            continue
+                        self._emit(int(slot), req, int(toks_host[d, slot]),
+                                   emitted, finished, first=False)
 
         gauges = dict(
             tokens_in_flight=int(self._slot_len[self._active].sum()),
@@ -648,9 +726,14 @@ class Engine:
         ``step()``. With a :class:`~gradaccum_tpu.serving.server.
         ServingServer` attached, call ``server.cancel()`` instead — it
         holds the engine lock."""
+        tr = self.tracer
         if self.scheduler.cancel(request_id):
             self.status[request_id] = "cancelled"
             self.metrics.record_finish(request_id, "cancelled")
+            ts0 = self._req_submit_ts.pop(request_id, None)
+            if tr.enabled and ts0 is not None:
+                tr.complete("req/queue", ts0, cat="request",
+                            rid=request_id, outcome="cancelled")
             return True
         for slot, req in enumerate(self._slot_req):
             if req is not None and req.request_id == request_id:
@@ -661,6 +744,10 @@ class Engine:
                 self._slot_limit[slot] = 0
                 self.status[request_id] = "cancelled"
                 self.metrics.record_finish(request_id, "cancelled")
+                ts0 = self._req_admit_ts.pop(request_id, None)
+                if tr.enabled and ts0 is not None:
+                    tr.complete("req/decode", ts0, cat="request",
+                                rid=request_id, outcome="cancelled")
                 return True
         return False
 
@@ -678,6 +765,7 @@ class Engine:
         :class:`~gradaccum_tpu.serving.server.ServingServer`).
         """
         failed = []
+        tr = self.tracer
         self._pending_match.clear()
         for slot, req in enumerate(self._slot_req):
             if req is None:
@@ -690,6 +778,10 @@ class Engine:
             # close out the metrics lifecycle too, or the per-request
             # timing entries leak for every faulted request forever
             self.metrics.record_finish(req.request_id, "error")
+            ts0 = self._req_admit_ts.pop(req.request_id, None)
+            if tr.enabled and ts0 is not None:
+                tr.complete("req/decode", ts0, cat="request",
+                            rid=req.request_id, outcome="error")
         device_arrays = (self.pool.k, self.pool.v, self.pool.lengths,
                          self._cur_tok, self._gen, self._rngs, self._limit)
         if any(getattr(a, "is_deleted", lambda: False)() for a in device_arrays):
@@ -712,6 +804,12 @@ class Engine:
             self._limit = jnp.zeros((num_slots,), jnp.int32)
             self._slot_len[:] = 0
             self._slot_limit[:] = 0
+            rebuilt = True
+        else:
+            rebuilt = False
+        if tr.enabled:
+            tr.event("serve/recover", cat="resilience", tick=self._tick,
+                     failed=len(failed), pool_rebuilt=rebuilt)
         return failed
 
     def run_until_idle(self, max_ticks: int = 100_000) -> List[StepEvents]:
@@ -735,6 +833,19 @@ class Engine:
         return min(b, self.max_len)
 
     def _admit(self, reqs, emitted, finished, admitted) -> None:
+        tr = self.tracer
+        enabled = tr.enabled
+        now = tr.now() if enabled else 0.0
+        for r in reqs:
+            # the queue span closes here (submit -> admission) and the
+            # service span opens — both keyed by rid on one timeline;
+            # submit entries pop even when tracing was disabled mid-queue
+            ts0 = self._req_submit_ts.pop(r.request_id, None)
+            if enabled:
+                if ts0 is not None:
+                    tr.complete("req/queue", ts0, cat="request",
+                                rid=r.request_id, outcome="admitted")
+                self._req_admit_ts[r.request_id] = now
         slots = self.pool.claim_many(len(reqs))
         assert len(slots) == len(reqs), "scheduler admitted beyond free slots"
         # register slot->request BEFORE the prefill dispatch: these requests
@@ -847,11 +958,18 @@ class Engine:
             # hit-rate denominator: only admissions that COULD have hit —
             # a sub-page prompt has no full chunk to match by construction
             eligible = prefix and r.prompt.size > self.page_size
+            n_shared = len(matches.get(r.request_id, ()))
             self.metrics.record_admission(
                 computed_tokens=tails[i], skipped_tokens=skipped,
-                shared_blocks=len(matches.get(r.request_id, ())),
+                shared_blocks=n_shared,
                 prefix_hit=(skipped > 0) if eligible else None,
             )
+            if tr.enabled:
+                # block / prefix-cache attribution for this admission
+                tr.event("req/admit", cat="request", rid=r.request_id,
+                         computed_tokens=int(tails[i]),
+                         skipped_tokens=int(skipped),
+                         shared_blocks=int(n_shared))
         self.pool.set_arrays(k, v, lengths)
         tok0_host = np.asarray(jax.device_get(tok0))
         for slot, req, tok in zip(slots, reqs, tok0_host):
@@ -879,3 +997,8 @@ class Engine:
             self.status[rid] = "done"
             finished.append((rid, reason))
             self.metrics.record_finish(rid, reason)
+            tr = self.tracer
+            ts0 = self._req_admit_ts.pop(rid, None)
+            if tr.enabled and ts0 is not None:
+                tr.complete("req/decode", ts0, cat="request", rid=rid,
+                            outcome=reason, tokens=len(out))

@@ -5,10 +5,14 @@ with plain ints/floats it already has. The clock is injectable so the
 deterministic simulation driver can run on the LOGICAL tick clock (results
 reproducible bit-for-bit) while the threaded server uses wall time.
 
-Scalars stream into TensorBoard through the same
-:class:`~gradaccum_tpu.estimator.events.EventWriter` the training loop
-uses (``model_dir/serving``), so one ``tensorboard --logdir`` shows the
-training curves next to queue depth / occupancy / tokens-per-second.
+Scalars route through one :class:`~gradaccum_tpu.obs.metrics.
+MetricsRegistry` (pass your own, or one is built internally), which still
+streams to the same :class:`~gradaccum_tpu.estimator.events.EventWriter`
+the training loop uses (``model_dir/serving``) — so one ``tensorboard
+--logdir`` shows the training curves next to queue depth / occupancy /
+tokens-per-second, while ``registry.snapshot()`` /
+``registry.to_prometheus()`` expose the same numbers to crash dumps and
+scrapers.
 """
 
 from __future__ import annotations
@@ -17,6 +21,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from gradaccum_tpu.estimator.events import EventWriter
+from gradaccum_tpu.obs.metrics import MetricsRegistry
 from gradaccum_tpu.utils.timing import LatencySeries
 
 
@@ -28,10 +33,11 @@ class ServingMetrics:
         event_writer: Optional[EventWriter] = None,
         subdir: str = "serving",
         clock: Callable[[], float] = time.monotonic,
+        registry: Optional[MetricsRegistry] = None,
     ):
         self.clock = clock
-        self._writer = event_writer
-        self._subdir = subdir
+        self.registry = registry if registry is not None else \
+            MetricsRegistry(event_writer=event_writer, subdir=subdir)
         self.ttft = LatencySeries()          # submit -> first token
         self.token_latency = LatencySeries()  # inter-token gap, per request
         self.queue_depth = LatencySeries()    # sampled per tick
@@ -62,6 +68,23 @@ class ServingMetrics:
         self.finished: Dict[str, int] = {}  # reason -> count
         self.rejected = 0
         self._t0: Optional[float] = None
+        # expose the latency series as registry histograms (shared storage,
+        # no double bookkeeping) and keep hot-path counters as bound attrs
+        # so record_token stays an attribute load + int add
+        reg = self.registry
+        for name, series in (
+            ("serving/ttft", self.ttft),
+            ("serving/token_latency", self.token_latency),
+            ("serving/queue_depth_series", self.queue_depth),
+            ("serving/occupancy_series", self.occupancy),
+        ):
+            reg.histogram(name, series=series)
+        self._c_tokens = reg.counter("serving/tokens_emitted_total")
+        self._c_rejected = reg.counter("serving/rejected_total")
+        self._c_prefill_computed = reg.counter(
+            "serving/prefill_tokens_computed_total")
+        self._c_prefill_skipped = reg.counter(
+            "serving/prefill_tokens_skipped_total")
 
     # -- per-request lifecycle -------------------------------------------
 
@@ -73,6 +96,7 @@ class ServingMetrics:
 
     def record_reject(self, request_id: int) -> None:
         self.rejected += 1
+        self._c_rejected.inc()
 
     def record_token(self, request_id: int, first: bool) -> None:
         now = self.clock()
@@ -82,9 +106,11 @@ class ServingMetrics:
             self.token_latency.add(now - self._last_token_t[request_id])
         self._last_token_t[request_id] = now
         self.tokens_emitted += 1
+        self._c_tokens.inc()
 
     def record_finish(self, request_id: int, reason: str) -> None:
         self.finished[reason] = self.finished.get(reason, 0) + 1
+        self.registry.counter(f"serving/finished_{reason}_total").inc()
         self._submit_t.pop(request_id, None)
         self._last_token_t.pop(request_id, None)
 
@@ -98,6 +124,8 @@ class ServingMetrics:
         hit-rate denominator only counts admissions that COULD have hit."""
         self.prefill_tokens_computed += int(computed_tokens)
         self.prefill_tokens_skipped += int(skipped_tokens)
+        self._c_prefill_computed.inc(int(computed_tokens))
+        self._c_prefill_skipped.inc(int(skipped_tokens))
         self.blocks_saved += int(shared_blocks)
         if prefix_hit is not None:
             if prefix_hit:
@@ -151,8 +179,9 @@ class ServingMetrics:
                     or shared_blocks > self.shared_blocks_peak):
                 self.shared_blocks_peak = shared_blocks
             scalars["serving/shared_kv_blocks"] = float(shared_blocks)
-        if self._writer is not None and self._writer.active:
-            self._writer.scalars(scalars, step=self.ticks, subdir=self._subdir)
+        # one call: records every scalar as a registry gauge AND streams to
+        # the EventWriter when one is attached
+        self.registry.publish(scalars, step=self.ticks)
 
     # -- summary ----------------------------------------------------------
 
@@ -199,6 +228,11 @@ class ServingMetrics:
             "rejected": self.rejected,
         }
 
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition of the registry view (counters,
+        per-tick gauges, latency quantiles) — what a serving host exposes
+        on a metrics endpoint."""
+        return self.registry.to_prometheus()
+
     def flush(self) -> None:
-        if self._writer is not None:
-            self._writer.flush()
+        self.registry.flush()

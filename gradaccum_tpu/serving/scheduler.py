@@ -75,9 +75,27 @@ class Scheduler:
         # why admission stalled, per tick it stalled: "no_free_slots" vs
         # "no_free_blocks" tells an operator which resource to grow
         self.stalls: Dict[str, int] = {}
+        # obs span tracer; an owning Engine built with an injected tracer
+        # wires it in so stall events land on that engine's timeline —
+        # otherwise the process-global tracer is resolved per use
+        self._tracer = None
+
+    @property
+    def tracer(self):
+        from gradaccum_tpu.obs import trace as obs_trace
+
+        return obs_trace.resolve(self._tracer)
+
+    @tracer.setter
+    def tracer(self, tracer) -> None:
+        self._tracer = tracer
 
     def record_stall(self, reason: str) -> None:
         self.stalls[reason] = self.stalls.get(reason, 0) + 1
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("serve/admission_stall", cat="serving", reason=reason,
+                     depth=len(self._queue))
 
     @property
     def depth(self) -> int:

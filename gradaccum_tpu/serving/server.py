@@ -153,6 +153,9 @@ class ServingServer:
     ``watchdog_timeout``: seconds one tick may run before the serving loop
     is declared stalled — pending handles fail immediately rather than
     blocking forever on a wedged dispatch.
+    ``flight``: an optional :class:`~gradaccum_tpu.obs.flight.
+    FlightRecorder` — every recovered engine fault, the give-up path, and
+    a watchdog fire each dump the recent-event ring as a postmortem.
     """
 
     def __init__(
@@ -162,8 +165,10 @@ class ServingServer:
         max_requeues: int = 1,
         max_engine_faults: int = 3,
         watchdog_timeout: Optional[float] = None,
+        flight=None,
     ):
         self._engine = engine
+        self._flight = flight
         self._idle_sleep = idle_sleep
         self._max_requeues = max_requeues
         self._max_engine_faults = max_engine_faults
@@ -181,7 +186,10 @@ class ServingServer:
         self._error: Optional[BaseException] = None
         self._watchdog = (
             None if watchdog_timeout is None
-            else Watchdog(watchdog_timeout, self._on_stall)
+            # pin only an explicitly injected engine tracer; None lets the
+            # watchdog resolve the global at fire time (same as the engine)
+            else Watchdog(watchdog_timeout, self._on_stall,
+                          tracer=engine._tracer)
         )
 
     def start(self) -> "ServingServer":
@@ -345,6 +353,15 @@ class ServingServer:
             f"engine tick stalled for {elapsed:.2f}s "
             f"(watchdog timeout {self._watchdog.timeout}s)"
         ))
+        if self._flight is not None:
+            # the ring holds the ticks leading into the stall — exactly the
+            # postmortem an operator needs for a wedged dispatch (best-
+            # effort: the stall itself is already the story)
+            try:
+                self._flight.dump("watchdog-stall",
+                                  extra={"elapsed_s": round(elapsed, 3)})
+            except Exception:  # noqa: BLE001
+                pass
 
     def _handle_engine_fault(self, exc: BaseException) -> None:
         """Recover the engine, requeue in-flight requests (bounded), fail
@@ -352,6 +369,11 @@ class ServingServer:
         ``max_engine_faults`` consecutive faulted ticks."""
         self._faults += 1
         give_up = self._faults > self._max_engine_faults
+        tr = self._engine.tracer
+        if tr.enabled:
+            tr.event("serve/engine_fault", cat="resilience",
+                     error=type(exc).__name__,
+                     consecutive=self._faults, give_up=give_up)
         with self._hlock:
             known = list(self._handles)
         retired = []
@@ -422,9 +444,23 @@ class ServingServer:
                 handle.request_id = rid
                 self._handles[rid] = handle
                 self._requeues[rid] = n + 1
+            if tr.enabled:
+                tr.event("req/requeue", cat="resilience", rid=rid,
+                         prior_rid=req.request_id, attempt=n + 1)
         for handle in dead:
             if handle.error is None:
                 handle._fail(exc)
+        if self._flight is not None:
+            # every recovered fault ships its own postmortem (the ring at
+            # this instant: the faulted tick, the recover, the requeues);
+            # best-effort — a failed dump (unwritable dir, full disk) must
+            # not turn a RECOVERED fault into a fatal loop error
+            try:
+                self._flight.dump("engine-fault-giveup" if give_up
+                                  else "engine-fault",
+                                  extra={"error": repr(exc)})
+            except Exception:  # noqa: BLE001
+                pass
 
     def _loop(self) -> None:
         try:
@@ -484,15 +520,21 @@ class SimulationDriver:
     """Replays seeded arrival traces on the logical tick clock.
 
     Rewires the engine's metrics clock to tick counts, so TTFT/latency
-    summaries come out in TICKS — deterministic across machines. Arrivals
-    that hit queue backpressure retry on subsequent ticks (closed-loop),
-    keeping the replay deterministic under overload too.
+    summaries come out in TICKS — deterministic across machines. An
+    engine carrying a DETERMINISTIC obs tracer gets the same treatment:
+    its span clock becomes the logical tick clock, which is what makes
+    two seeded runs export byte-identical trace JSON (the tier-1 ``obs``
+    gate). Arrivals that hit queue backpressure retry on subsequent ticks
+    (closed-loop), keeping the replay deterministic under overload too.
     """
 
     def __init__(self, engine: Engine, seed: int = 0):
         self.engine = engine
         self.seed = seed
         engine.metrics.clock = lambda: float(engine.tick_count)
+        tracer = getattr(engine, "tracer", None)
+        if tracer is not None and getattr(tracer, "deterministic", False):
+            tracer.clock = lambda: float(engine.tick_count)
 
     def make_trace(
         self,

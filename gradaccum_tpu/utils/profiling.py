@@ -22,14 +22,29 @@ from typing import Iterator, Optional
 
 @contextlib.contextmanager
 def trace(log_dir: str) -> Iterator[None]:
-    """Trace the enclosed region into ``log_dir`` (TensorBoard-loadable)."""
+    """Trace the enclosed region into ``log_dir`` (TensorBoard-loadable).
+
+    Exception-safe around the profiler itself: if ``start_trace`` raises
+    (profiler unavailable off-TPU, a trace already active, an unwritable
+    dir) the region still runs — profiling degrades to a no-op instead of
+    erroring — and ``stop_trace`` is only ever called against a trace that
+    actually started."""
     import jax
 
-    jax.profiler.start_trace(log_dir)
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception as e:  # noqa: BLE001 — observability, never fatal
+        print(f"[profile] trace unavailable ({e}); running unprofiled")
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                print(f"[profile] stop_trace failed ({e})")
 
 
 class StepWindowProfiler:
@@ -59,14 +74,22 @@ class StepWindowProfiler:
         if step >= self.start_step:
             import jax
 
-            jax.profiler.start_trace(self.log_dir)
+            try:
+                jax.profiler.start_trace(self.log_dir)
+            except Exception as e:  # noqa: BLE001 — degrade, don't kill training
+                print(f"[profile] trace unavailable ({e}); window skipped")
+                self._done = True
+                return
             self._active = True
 
     def close(self) -> None:
         if self._active:
             import jax
 
-            jax.profiler.stop_trace()
             self._active = False
-            print(f"[profile] trace written to {self.log_dir}")
+            try:
+                jax.profiler.stop_trace()
+                print(f"[profile] trace written to {self.log_dir}")
+            except Exception as e:  # noqa: BLE001
+                print(f"[profile] stop_trace failed ({e})")
         self._done = True

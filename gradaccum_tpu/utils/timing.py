@@ -65,7 +65,8 @@ def time_device_steps(step, state, step_args, iters: int):
 
 class LatencySeries:
     """A scalar sample series with the summary the serving path reports
-    everywhere (mean / p50 / p99 / count). Shared by serving/metrics.py and
+    everywhere (mean / p50 / p90 / p99 / count). Shared by
+    serving/metrics.py, the obs metrics registry's histograms, and
     examples/bench_serving.py so every artifact quotes percentiles computed
     the same way (numpy linear interpolation)."""
 
@@ -81,15 +82,23 @@ class LatencySeries:
     def __len__(self) -> int:
         return len(self._xs)
 
+    def percentiles(self, qs=(50, 90, 99)) -> dict:
+        """``{"p50": ..., "p90": ..., ...}`` for the requested quantiles
+        (None-valued when the series is empty)."""
+        import numpy as np
+
+        if not self._xs:
+            return {f"p{q:g}": None for q in qs}
+        a = np.asarray(self._xs, np.float64)
+        return {f"p{q:g}": float(np.percentile(a, q)) for q in qs}
+
     def summary(self) -> dict:
         import numpy as np
 
         if not self._xs:
-            return {"count": 0, "mean": None, "p50": None, "p99": None}
+            return {"count": 0, "mean": None,
+                    "p50": None, "p90": None, "p99": None}
         a = np.asarray(self._xs, np.float64)
-        return {
-            "count": int(a.size),
-            "mean": float(a.mean()),
-            "p50": float(np.percentile(a, 50)),
-            "p99": float(np.percentile(a, 99)),
-        }
+        out = {"count": int(a.size), "mean": float(a.mean())}
+        out.update(self.percentiles())
+        return out

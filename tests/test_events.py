@@ -1,0 +1,192 @@
+"""EventWriter backend fallback + StepWindowProfiler window edges.
+
+The contract under test: event files are OBSERVABILITY, never a
+dependency — ``GRADACCUM_EVENTS=0`` and a missing torch must both produce
+ZERO files and zero errors through the full scalar/flush/close API — and
+the profiler must trace exactly its window (never off the edges, never
+after a failed start).
+"""
+
+import os
+import sys
+
+import pytest
+
+
+# -- EventWriter fallback -----------------------------------------------------
+
+
+def _exercise(writer):
+    writer.scalar("loss", 1.0, step=0)
+    writer.scalars({"a": 1.0, "b": 2.0}, step=1, subdir="eval")
+    writer.flush()
+    writer.close()
+
+
+def test_events_opt_out_writes_nothing(tmp_path, monkeypatch):
+    """GRADACCUM_EVENTS=0: inactive writer, zero files, zero errors."""
+    monkeypatch.setenv("GRADACCUM_EVENTS", "0")
+    from gradaccum_tpu.estimator.events import EventWriter
+
+    writer = EventWriter(str(tmp_path))
+    assert not writer.active
+    _exercise(writer)
+    assert list(tmp_path.rglob("*")) == []
+
+
+def test_events_missing_torch_writes_nothing(tmp_path, monkeypatch):
+    """No importable tensorboard backend: silent no-op, zero files."""
+    monkeypatch.delenv("GRADACCUM_EVENTS", raising=False)
+    # a None sys.modules entry makes the runtime import raise ImportError
+    monkeypatch.setitem(sys.modules, "torch", None)
+    monkeypatch.setitem(sys.modules, "torch.utils", None)
+    monkeypatch.setitem(sys.modules, "torch.utils.tensorboard", None)
+    from gradaccum_tpu.estimator.events import EventWriter
+
+    writer = EventWriter(str(tmp_path))
+    assert not writer.active
+    _exercise(writer)
+    assert list(tmp_path.rglob("*")) == []
+
+
+def test_events_no_model_dir_is_inactive():
+    from gradaccum_tpu.estimator.events import EventWriter
+
+    writer = EventWriter(None)
+    assert not writer.active
+    _exercise(writer)
+
+
+# -- StepWindowProfiler window edges ------------------------------------------
+
+
+class _FakeProfiler:
+    """Counts start/stop calls; optionally fails start (off-TPU parity)."""
+
+    def __init__(self, fail=False):
+        self.starts = 0
+        self.stops = 0
+        self.fail = fail
+
+    def start_trace(self, log_dir):
+        if self.fail:
+            raise RuntimeError("profiler unavailable on this backend")
+        self.starts += 1
+
+    def stop_trace(self):
+        self.stops += 1
+
+
+@pytest.fixture
+def fake_profiler(monkeypatch):
+    import jax
+
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+    return fake
+
+
+def test_profiler_zero_width_window_never_traces(tmp_path, fake_profiler):
+    from gradaccum_tpu.utils.profiling import StepWindowProfiler
+
+    prof = StepWindowProfiler(str(tmp_path), start_step=0, num_steps=0)
+    for step in range(5):
+        prof.observe(step)
+    prof.close()
+    assert fake_profiler.starts == 0 and fake_profiler.stops == 0
+
+
+def test_profiler_window_past_end_of_training_never_traces(
+        tmp_path, fake_profiler):
+    """A window the run never reaches: no start, and close() must not
+    stop a never-started trace."""
+    from gradaccum_tpu.utils.profiling import StepWindowProfiler
+
+    prof = StepWindowProfiler(str(tmp_path), start_step=100, num_steps=5)
+    for step in range(10):  # training ends long before the window opens
+        prof.observe(step)
+    prof.close()
+    assert fake_profiler.starts == 0 and fake_profiler.stops == 0
+
+
+def test_profiler_window_at_step_zero_traces_exactly_once(
+        tmp_path, fake_profiler):
+    from gradaccum_tpu.utils.profiling import StepWindowProfiler
+
+    prof = StepWindowProfiler(str(tmp_path), start_step=0, num_steps=3)
+    for step in range(10):
+        prof.observe(step)
+    prof.close()
+    assert fake_profiler.starts == 1 and fake_profiler.stops == 1
+    # the window closed at its edge, not at close(): steps 3..9 untraced
+    assert prof._done and not prof._active
+
+
+def test_profiler_failed_start_degrades_to_noop(tmp_path, monkeypatch):
+    """start_trace raising (off-TPU): the window is skipped, training
+    continues, and no stop_trace runs against a never-started trace."""
+    import jax
+
+    from gradaccum_tpu.utils.profiling import StepWindowProfiler
+
+    fake = _FakeProfiler(fail=True)
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+    prof = StepWindowProfiler(str(tmp_path), start_step=2, num_steps=3)
+    for step in range(8):
+        prof.observe(step)  # must not raise
+    prof.close()
+    assert fake.stops == 0
+
+
+def test_trace_context_manager_failed_start_is_noop(monkeypatch):
+    import jax
+
+    from gradaccum_tpu.utils import profiling
+
+    fake = _FakeProfiler(fail=True)
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+    ran = []
+    with profiling.trace("/nonexistent/dir"):
+        ran.append(True)  # the region still runs
+    assert ran and fake.stops == 0
+
+
+def test_estimator_events_fallback_trains_without_files(tmp_path, monkeypatch):
+    """End to end: a model_dir training run with GRADACCUM_EVENTS=0
+    produces checkpoints and the loss CSV but zero event files."""
+    monkeypatch.setenv("GRADACCUM_EVENTS", "0")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.estimator.config import RunConfig
+    from gradaccum_tpu.estimator.estimator import Estimator, ModelBundle
+
+    def loss(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    bundle = ModelBundle(
+        init=lambda rng, s: {"w": jnp.zeros((3, 1))},
+        loss=loss,
+        predict=lambda p, b: {"predictions": b["x"] @ p["w"]},
+        eval_metrics={},
+    )
+    rng = np.random.default_rng(0)
+    batches = [{"x": rng.normal(size=(4, 3)).astype(np.float32),
+                "y": rng.normal(size=(4, 1)).astype(np.float32)}
+               for _ in range(8)]
+    est = Estimator(
+        bundle, gt.ops.sgd(0.1), gt.GradAccumConfig(num_micro_batches=2),
+        RunConfig(model_dir=str(tmp_path), save_checkpoints_steps=4,
+                  log_step_count_steps=1000),
+        mode="streaming",
+    )
+    est.train(batches, max_steps=8)
+    est.close()
+    files = [p.name for p in tmp_path.rglob("*") if p.is_file()]
+    assert "loss_vs_step.csv" in files
+    assert not any(f.startswith("events.out.tfevents") for f in files)

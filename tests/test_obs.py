@@ -1,0 +1,560 @@
+"""Unified observability layer: spans, metrics registry, flight recorder.
+
+The load-bearing test is the DETERMINISM GATE: two seeded simulation runs
+with a deterministic tracer must export byte-identical Chrome trace-event
+JSON — the property that makes traces diffable across machines and CI
+runs. Around it: the strict-no-op contract of the kill switch, request
+lifecycle span coverage, registry snapshot/Prometheus export, flight-dump
+round-trips (including the chaos contract: every injected fault in the
+dumped ring), and the obs_report renderer.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+pytestmark = pytest.mark.obs
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(
+        jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)}
+    )
+    return cfg, bundle, params
+
+
+def _sim_run(tiny_lm, seed, tracer, **engine_kwargs):
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=4, max_len=32, tracer=tracer,
+                    **engine_kwargs)
+    driver = SimulationDriver(engine, seed=seed)
+    trace = driver.make_trace(8, arrival_rate=0.6, prompt_len=(1, 10),
+                              max_new=(2, 10))
+    driver.run(trace)
+    return engine
+
+
+# -- the determinism gate -----------------------------------------------------
+
+
+def test_trace_byte_identical_across_seeded_sim_runs(tiny_lm):
+    """Two seeded sim runs -> byte-identical trace-event JSON (and a third
+    with a different seed differs): the tier-1 obs determinism gate."""
+    from gradaccum_tpu.obs.trace import Tracer
+
+    def run(seed):
+        tracer = Tracer(deterministic=True, capacity=None)
+        _sim_run(tiny_lm, seed, tracer)
+        return tracer.to_bytes()
+
+    a, b, c = run(5), run(5), run(6)
+    assert a == b
+    assert a != c
+
+
+def test_trace_byte_identical_paged_prefix_run(tiny_lm):
+    """Determinism holds on the paged+prefix path too (admission events
+    carry block/prefix attribution)."""
+    from gradaccum_tpu.obs.trace import Tracer
+
+    def run():
+        tracer = Tracer(deterministic=True, capacity=None)
+        _sim_run(tiny_lm, 9, tracer, page_size=8, prefix_cache=True)
+        return tracer.to_bytes()
+
+    assert run() == run()
+
+
+# -- span coverage ------------------------------------------------------------
+
+
+def test_request_lifecycle_spans(tiny_lm):
+    """Every request shows queue + decode spans and submit/admit instants;
+    ticks carry decode/prefill child spans."""
+    from gradaccum_tpu.obs.trace import Tracer
+
+    tracer = Tracer(deterministic=True, capacity=None)
+    _sim_run(tiny_lm, 1, tracer)
+    events = tracer.snapshot()
+    names = [e["name"] for e in events]
+    n_req = names.count("req/submit")
+    assert n_req == 8
+    assert names.count("req/queue") == n_req
+    assert names.count("req/admit") == n_req
+    assert names.count("req/decode") == n_req
+    assert names.count("serve/tick") > 0
+    assert names.count("serve/decode") > 0
+    for ev in events:
+        if ev["name"] == "req/decode":
+            assert ev["args"]["outcome"] in ("eos", "length")
+        assert "seq" in ev["args"]  # the logical clock rides every event
+
+    # seq is a total order: strictly increasing in emission order
+    seqs = [e["args"]["seq"] for e in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+def test_timeout_and_cancel_close_queue_spans(tiny_lm):
+    from gradaccum_tpu.obs.trace import Tracer
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    tracer = Tracer(deterministic=True, capacity=None)
+    engine = Engine(params, cfg, num_slots=1, max_len=32, tracer=tracer)
+    tracer.clock = lambda: float(engine.tick_count)
+    running = engine.submit([1, 2], max_new_tokens=8)
+    expired = engine.submit([3], max_new_tokens=4, deadline_ticks=0)
+    cancelled = engine.submit([4], max_new_tokens=4)
+    assert engine.cancel(cancelled)
+    for _ in range(4):
+        engine.step()
+    outcomes = {
+        e["args"]["rid"]: e["args"]["outcome"]
+        for e in tracer.snapshot() if e["name"] == "req/queue"
+    }
+    assert outcomes[expired] == "timeout"
+    assert outcomes[cancelled] == "cancelled"
+    assert outcomes[running] == "admitted"
+    # no span-timestamp bookkeeping may leak once requests leave the queue
+    assert expired not in engine._req_submit_ts
+    assert cancelled not in engine._req_submit_ts
+
+
+def test_tracer_disabled_mid_flight_still_pops_span_bookkeeping(tiny_lm):
+    """Submit while tracing, finish while disabled: the per-request
+    timestamp entries must still pop (no leak on a long-lived server
+    whose operator toggles tracing)."""
+    from gradaccum_tpu.obs import trace as obs_trace
+    from gradaccum_tpu.obs.trace import NULL, Tracer
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    with obs_trace.installed(Tracer(deterministic=True, capacity=None)):
+        running = engine.submit([1, 2], max_new_tokens=3)
+        queued_cancel = engine.submit([3], max_new_tokens=3)
+        expired = engine.submit([4], max_new_tokens=3, deadline_ticks=0)
+        assert running in engine._req_submit_ts
+    with obs_trace.installed(NULL):  # tracing turned off mid-flight
+        assert engine.cancel(queued_cancel)
+        while not engine.idle:
+            engine.step()
+    assert engine._req_submit_ts == {} and engine._req_admit_ts == {}
+
+
+def test_disabled_tracer_records_nothing_and_leaks_nothing(tiny_lm):
+    """NullTracer engine: zero events, zero per-request timestamp state —
+    the strict no-op contract on the hot path."""
+    from gradaccum_tpu.obs.trace import NULL
+
+    engine = _sim_run(tiny_lm, 2, NULL)
+    assert NULL.snapshot() == []
+    assert engine._req_submit_ts == {} and engine._req_admit_ts == {}
+
+
+def test_kill_switch_disables_global_tracer(monkeypatch):
+    from gradaccum_tpu.obs import trace as obs_trace
+
+    monkeypatch.setenv("GRADACCUM_OBS", "0")
+    tr = obs_trace.get_tracer()
+    assert not tr.enabled
+    tr.event("x")  # no-op, no error
+    assert tr.snapshot() == []
+    monkeypatch.setenv("GRADACCUM_OBS", "1")
+    assert obs_trace.get_tracer().enabled
+
+
+def test_installed_tracer_wins_over_kill_switch(monkeypatch):
+    """The env switch governs the DEFAULT tracer only: chaos_smoke /
+    bench_obs install their own and must keep recording regardless."""
+    from gradaccum_tpu.obs import trace as obs_trace
+    from gradaccum_tpu.obs.trace import Tracer
+
+    monkeypatch.setenv("GRADACCUM_OBS", "0")
+    mine = Tracer(deterministic=True, capacity=None)
+    with obs_trace.installed(mine):
+        tr = obs_trace.get_tracer()
+        assert tr is mine and tr.enabled
+        tr.event("recorded-under-kill-switch")
+        assert [e["name"] for e in mine.snapshot()] == \
+            ["recorded-under-kill-switch"]
+    # back outside the install, the switch applies again
+    assert not obs_trace.get_tracer().enabled
+
+
+def test_engine_follows_tracer_installed_after_construction(tiny_lm):
+    """An engine built WITHOUT an injected tracer resolves the global per
+    use: installing one later puts this engine's spans on its timeline."""
+    from gradaccum_tpu.obs import trace as obs_trace
+    from gradaccum_tpu.obs.trace import Tracer
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    late = Tracer(deterministic=True, capacity=None)
+    with obs_trace.installed(late):
+        late.clock = lambda: float(engine.tick_count)
+        engine.submit([1, 2], max_new_tokens=3)
+        while not engine.idle:
+            engine.step()
+    names = [e["name"] for e in late.snapshot()]
+    assert "serve/tick" in names and "req/decode" in names
+
+
+def test_ring_capacity_bounds_and_counts_drops():
+    from gradaccum_tpu.obs.trace import Tracer
+
+    tracer = Tracer(deterministic=True, capacity=4)
+    for i in range(10):
+        tracer.event("e", i=i)
+    events = tracer.snapshot()
+    assert len(events) == 4
+    assert [e["args"]["i"] for e in events] == [6, 7, 8, 9]
+    assert tracer.dropped == 6
+
+
+# -- train-side spans ---------------------------------------------------------
+
+
+def _train(tmp_path, tracer, *, crash_at=None, max_steps=8):
+    import jax.numpy as jnp
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.estimator.config import RunConfig
+    from gradaccum_tpu.estimator.estimator import Estimator, ModelBundle
+    from gradaccum_tpu.obs import trace as obs_trace
+    from gradaccum_tpu.resilience import faults
+
+    def loss(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    bundle = ModelBundle(
+        init=lambda rng, s: {"w": jnp.zeros((3, 1))},
+        loss=loss,
+        predict=lambda p, b: {"predictions": b["x"] @ p["w"]},
+        eval_metrics={},
+    )
+    rng = np.random.default_rng(0)
+    batches = [{"x": rng.normal(size=(4, 3)).astype(np.float32),
+                "y": rng.normal(size=(4, 1)).astype(np.float32)}
+               for _ in range(max_steps)]
+    est = Estimator(
+        bundle, gt.ops.sgd(0.1), gt.GradAccumConfig(num_micro_batches=4),
+        RunConfig(model_dir=str(tmp_path), save_checkpoints_steps=4,
+                  log_step_count_steps=1000),
+        mode="streaming",
+    )
+    with obs_trace.installed(tracer):
+        if crash_at is not None:
+            schedule = faults.FaultSchedule(
+                [faults.FaultSpec(faults.POST_TRAIN_STEP, at=crash_at)]
+            )
+            with faults.installed(faults.FaultInjector(schedule)):
+                with pytest.raises(faults.InjectedCrash):
+                    est.train(batches, max_steps=max_steps)
+        else:
+            est.train(batches, max_steps=max_steps)
+    est.close()
+    return est
+
+
+def test_train_step_spans_label_accumulate_vs_apply(tmp_path):
+    from gradaccum_tpu.obs.trace import Tracer
+
+    tracer = Tracer(capacity=None)
+    _train(tmp_path, tracer, max_steps=8)
+    branches = [e["args"]["branch"] for e in tracer.snapshot()
+                if e["name"] == "train/step"]
+    assert len(branches) == 8
+    # K=4, first_step_quirk=True: the reference applies at step % 4 == 0
+    assert branches == ["apply", "accumulate", "accumulate", "accumulate"] * 2
+
+
+def test_crash_dumps_flight_record_with_fault_and_steps(tmp_path):
+    from gradaccum_tpu.obs import flight as obs_flight
+    from gradaccum_tpu.obs.trace import Tracer
+
+    tracer = Tracer(capacity=None)
+    _train(tmp_path, tracer, crash_at=5, max_steps=8)
+    dumps = obs_flight.list_dumps(str(tmp_path))
+    assert len(dumps) == 1
+    payload = obs_flight.load_dump(dumps[0])
+    assert payload["reason"] == "crash"
+    faults_seen = obs_flight.fault_events(payload["events"])
+    assert ("post_train_step", 5, "crash") in faults_seen
+    step_events = [e for e in payload["events"]
+                   if e["name"] == "train/step"]
+    assert len(step_events) == 5  # the ring holds the steps leading in
+    assert payload["metrics"]["gauges"]["loss"]["value"] is not None
+
+
+# -- metrics registry ---------------------------------------------------------
+
+
+def test_registry_counters_gauges_histograms_and_conflicts():
+    from gradaccum_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("requests_total").inc()
+    reg.counter("requests_total").inc(2)
+    reg.gauge("depth").set(3, step=7)
+    reg.histogram("lat").observe(1.0)
+    reg.histogram("lat").observe(3.0)
+    snap = reg.snapshot()
+    assert snap["counters"]["requests_total"] == 3
+    assert snap["gauges"]["depth"] == {"value": 3.0, "step": 7}
+    assert snap["histograms"]["lat"]["count"] == 2
+    assert snap["histograms"]["lat"]["p90"] is not None
+    with pytest.raises(ValueError):
+        reg.gauge("requests_total")
+
+
+def test_registry_histogram_rebinds_live_series():
+    """Re-registering a histogram with a NEW backing series (a rebuilt
+    ServingMetrics on a shared registry) must track the live instance,
+    not keep exporting the dead one's samples."""
+    from gradaccum_tpu.obs.metrics import MetricsRegistry
+    from gradaccum_tpu.utils.timing import LatencySeries
+
+    reg = MetricsRegistry()
+    old = LatencySeries()
+    reg.histogram("ttft", series=old)
+    old.add(1.0)
+    new = LatencySeries()
+    h = reg.histogram("ttft", series=new)
+    assert h.series is new
+    new.add(5.0)
+    assert reg.snapshot()["histograms"]["ttft"]["p50"] == 5.0
+    # plain lookups (no series) never rebind
+    assert reg.histogram("ttft").series is new
+
+
+def test_estimator_registry_rebinds_writer_after_close(tmp_path):
+    """close() + resume recreates the EventWriter; the registry bridge
+    must follow the live writer, not keep streaming into the closed one."""
+    from gradaccum_tpu.obs.trace import Tracer
+
+    est = _train(tmp_path, Tracer(capacity=None), max_steps=4)
+    assert est.registry._writer is est.events
+    est.close()  # detaches the writer; next access recreates it
+    assert est.registry._writer is est.events
+    est.close()
+
+
+def test_registry_prometheus_export():
+    from gradaccum_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("serving/tokens_emitted_total").inc(5)
+    reg.gauge("serving/queue-depth").set(2.0)
+    reg.histogram("serving/ttft").observe(0.5)
+    text = reg.to_prometheus()
+    assert "# TYPE serving_tokens_emitted_total counter" in text
+    assert "serving_tokens_emitted_total 5" in text
+    assert "serving_queue_depth 2.0" in text
+    assert 'serving_ttft{quantile="0.9"} 0.5' in text
+    assert "serving_ttft_count 1" in text
+
+
+def test_serving_metrics_absorbed_into_registry(tiny_lm):
+    """ServingMetrics scalars/series are visible through one registry:
+    per-tick gauges, lifetime counters, latency histograms, Prometheus."""
+    from gradaccum_tpu.obs.trace import NULL
+
+    engine = _sim_run(tiny_lm, 3, NULL)
+    reg = engine.metrics.registry
+    snap = reg.snapshot()
+    assert snap["counters"]["serving/tokens_emitted_total"] == \
+        engine.metrics.tokens_emitted
+    finished = sum(v for k, v in snap["counters"].items()
+                   if k.startswith("serving/finished_"))
+    assert finished == 8
+    assert snap["gauges"]["serving/queue_depth"]["step"] == \
+        engine.metrics.ticks
+    assert snap["histograms"]["serving/ttft"]["count"] == 8
+    assert "serving_ttft" in engine.metrics.to_prometheus()
+
+
+def test_latency_series_percentiles():
+    from gradaccum_tpu.utils.timing import LatencySeries
+
+    s = LatencySeries()
+    s.extend(range(1, 101))
+    out = s.summary()
+    assert out["p50"] == pytest.approx(50.5)
+    assert out["p90"] == pytest.approx(90.1)
+    assert out["p99"] == pytest.approx(99.01)
+    assert s.percentiles((50,)) == {"p50": pytest.approx(50.5)}
+    empty = LatencySeries().summary()
+    assert empty == {"count": 0, "mean": None,
+                     "p50": None, "p90": None, "p99": None}
+
+
+# -- serving resilience events ------------------------------------------------
+
+
+def test_engine_fault_events_and_flight_dump(tiny_lm, tmp_path):
+    """A mid-tick crash under the server: fault + recover + requeue events
+    on the timeline and a flight dump containing the injected fault."""
+    from gradaccum_tpu.obs import flight as obs_flight
+    from gradaccum_tpu.obs.trace import Tracer, installed
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    tracer = Tracer(capacity=None)
+    engine = Engine(params, cfg, num_slots=2, max_len=32, tracer=tracer)
+    recorder = obs_flight.FlightRecorder(str(tmp_path), tracer=tracer,
+                                         registry=engine.metrics.registry)
+    schedule = faults.FaultSchedule(
+        [faults.FaultSpec(faults.MID_DECODE_TICK, at=1)]
+    )
+    with installed(tracer), \
+            faults.installed(faults.FaultInjector(schedule)):
+        server = ServingServer(engine, max_requeues=2,
+                               flight=recorder).start()
+        handle = server.submit(np.asarray([1, 2, 3], np.int32), 5)
+        tokens, reason = handle.result(timeout=120)
+        server.stop()
+    assert reason in ("eos", "length") and len(tokens) >= 1
+
+    names = [e["name"] for e in tracer.snapshot()]
+    assert "fault/injected" in names
+    assert "serve/engine_fault" in names
+    assert "serve/recover" in names
+    assert "req/requeue" in names
+    dumps = obs_flight.list_dumps(str(tmp_path))
+    assert len(dumps) == 1
+    payload = obs_flight.load_dump(dumps[0])
+    assert payload["reason"] == "engine-fault"
+    assert ("mid_decode_tick", 1, "crash") in \
+        obs_flight.fault_events(payload["events"])
+
+
+# -- obs_report + bench aggregation -------------------------------------------
+
+
+def test_obs_report_renders_trace_and_correlates_faults(tiny_lm, tmp_path):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import obs_report
+
+    from gradaccum_tpu.obs.trace import Tracer, installed
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+    tracer = Tracer(deterministic=True, capacity=None)
+    engine = Engine(params, cfg, num_slots=4, max_len=32, tracer=tracer)
+    driver = SimulationDriver(engine, seed=11)
+    schedule = faults.FaultSchedule(
+        [faults.FaultSpec(faults.MID_DECODE_TICK, at=2,
+                          kind=faults.KIND_SLOW_TICK, delay=0.01)]
+    )
+    with installed(tracer), \
+            faults.installed(faults.FaultInjector(schedule)):
+        driver.run(driver.make_trace(6, arrival_rate=0.7))
+    path = tracer.export(str(tmp_path / "trace.json"))
+
+    events, n_files = obs_report.collect(path)
+    assert n_files == 1
+    rep = obs_report.report(events)
+    assert rep["serving"]["ticks"] == engine.tick_count
+    assert rep["serving"]["queue_wait"]["count"] == 6
+    assert rep["serving"]["service_time"]["p90"] is not None
+    assert len(rep["faults"]) == 1
+    assert rep["faults"][0]["fault"]["kind"] == "slow_tick"
+    out = tmp_path / "report.json"
+    assert obs_report.main([path, "--json", str(out)]) == 0
+    assert json.loads(out.read_text())["events"] == len(events)
+
+
+def test_obs_report_merges_overlapping_flight_dumps(tmp_path):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import obs_report
+
+    from gradaccum_tpu.obs import flight as obs_flight
+    from gradaccum_tpu.obs.trace import Tracer
+
+    tracer = Tracer(deterministic=True, capacity=None)
+    tracer.event("a", cat="x")
+    recorder = obs_flight.FlightRecorder(str(tmp_path), tracer=tracer)
+    recorder.dump("first")
+    tracer.event("b", cat="x")
+    recorder.dump("second")  # overlapping ring: event "a" appears twice
+    events, n_files = obs_report.collect(str(tmp_path))
+    assert n_files == 2
+    assert [e["name"] for e in events] == ["a", "b"]  # dedup'd
+
+
+def test_obs_report_keeps_both_runs_despite_seq_collision(tmp_path):
+    """Crash -> resume -> crash again: the second run's tracer restarts
+    seq at 0, but its dumps must not overwrite the first run's events."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import obs_report
+
+    from gradaccum_tpu.obs import flight as obs_flight
+    from gradaccum_tpu.obs.trace import Tracer
+
+    run_a = Tracer(deterministic=True, capacity=None)
+    run_a.event("fault/injected", cat="resilience",
+                point="post_train_step", index=3, kind="crash")
+    run_a.event("serve/recover", cat="resilience", requeued=1)
+    obs_flight.FlightRecorder(str(tmp_path), tracer=run_a).dump("crash")
+    run_b = Tracer(deterministic=True, capacity=None)  # seq restarts at 0
+    run_b.event("fault/injected", cat="resilience",
+                point="post_train_step", index=9, kind="crash")
+    obs_flight.FlightRecorder(str(tmp_path), tracer=run_b).dump("crash")
+
+    events, n_files = obs_report.collect(str(tmp_path))
+    assert n_files == 2
+    faults_seen = obs_flight.fault_events(events)
+    assert ("post_train_step", 3, "crash") in faults_seen
+    assert ("post_train_step", 9, "crash") in faults_seen
+    # fault->effect never pairs across runs: only run A has a recovery
+    rep = obs_report.report(events)
+    effects = {fx["fault"]["index"]:
+               (fx["effect"] or {}).get("name") for fx in rep["faults"]}
+    assert effects == {3: "serve/recover", 9: None}
+
+
+def test_bench_trend_aggregates_obs_artifact(tmp_path):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import bench_trend
+
+    art = {"bench": "observability overhead", "headline": "serve 1.01x",
+           "acceptance": {"required": "<= 5%", "passed": True}}
+    with open(tmp_path / "BENCH_obs.json", "w") as f:
+        json.dump(art, f)
+    rows = bench_trend.collect(str(tmp_path))
+    assert len(rows) == 1
+    assert rows[0]["passed"] is True
+    assert rows[0]["headline"] == "serve 1.01x"
+    assert bench_trend.main(["--dir", str(tmp_path)]) == 0
+
+
+@pytest.mark.slow
+def test_bench_obs_overhead_within_budget(tmp_path):
+    """Slow lane: run the real overhead bench and gate its acceptance."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import bench_obs
+
+    out = tmp_path / "BENCH_obs.json"
+    rc = bench_obs.main(["--json", str(out), "--repeats", "3",
+                         "--requests", "24", "--train-steps", "80"])
+    artifact = json.loads(out.read_text())
+    assert artifact["acceptance"]["passed"] is True and rc == 0

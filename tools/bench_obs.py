@@ -1,0 +1,278 @@
+"""Measure observability overhead: obs enabled vs disabled, train + serve.
+
+The obs layer's contract is that spans/counters on the hot paths are
+host-side dict/int work, dwarfed by the jitted dispatch they decorate.
+This bench checks that claim on both hot paths:
+
+- **serve**: a seeded simulation trace through a tiny GPT engine, timed
+  per tick, once with a :class:`NullTracer` (disabled) and once with a
+  recording :class:`Tracer`.
+- **train**: a tiny-GPT streaming-mode Estimator (the repo's actual
+  workload: jitted fwd+bwd with K-way gradient accumulation), timed per
+  step, with the global tracer swapped the same way.
+
+Methodology: ONE engine and ONE estimator serve every leg — the tracer
+is the only thing swapped between legs, so both legs run the identical
+compiled program and jit compilation never lands inside a timed window
+(the serve warmup replays the same-shaped trace first; replays rebase
+arrival ticks onto the engine's monotonically growing tick counter).
+
+The gating ratio is a DIRECT measurement: a traced leg captures the
+exact event stream the workload emits, a tight loop re-emits that
+stream into a fresh tracer (min over repeats — immune to scheduler
+bursts), and the per-op emission cost is divided by the uncontended
+(min-over-repeats) baseline op time. Differencing two ~equal wall-clock
+totals cannot resolve a low-single-digit-percent signal on a shared
+CPU — A/B runs here regularly disagree by more than the budget in BOTH
+directions, so those paired wall-clock ratios are recorded in the
+artifact as a cross-check (``ab_wall``) but do not gate. Writes
+``BENCH_obs.json`` with an acceptance block gated at <= 5% overhead,
+aggregated by ``tools/bench_trend.py``.
+
+Usage: python tools/bench_obs.py [--json PATH] [--repeats N]
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+REQUIRED = ("obs enabled vs disabled: <= 5% overhead per serving tick "
+            "and per train step (measured emission cost of the workload's "
+            "event stream over the uncontended baseline op time, CPU)")
+
+
+def _serve_setup(seed: int, n_requests: int):
+    """One warmed engine + driver + reusable trace shared by every leg."""
+    import jax
+    import numpy as np
+
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.obs.trace import NullTracer
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(jax.random.PRNGKey(0),
+                         {"input_ids": np.zeros((1, 8), np.int32)})
+    engine = Engine(params, cfg, num_slots=4, max_len=32,
+                    tracer=NullTracer())
+    driver = SimulationDriver(engine, seed=seed)
+    trace = driver.make_trace(n_requests, arrival_rate=0.6,
+                              prompt_len=(1, 12), max_new=(4, 12))
+    # warmup replays the SAME trace, so every prefill bucket and decode
+    # program the timed legs hit is compiled before any timer starts
+    driver.run(_rebased(trace, engine.tick_count))
+    return engine, driver, trace
+
+
+def _rebased(trace, base: int):
+    """The trace's arrival pattern, shifted onto the engine's current
+    tick — replays on a long-lived engine keep the original shape."""
+    return [dataclasses.replace(it, arrival_tick=it.arrival_tick + base)
+            for it in trace]
+
+
+def _serve_leg(engine, driver, trace, tracer):
+    """Seconds per tick replaying ``trace`` with ``tracer`` installed."""
+    engine.tracer = tracer
+    engine.scheduler.tracer = tracer
+    t0_ticks = engine.tick_count
+    t0 = time.perf_counter()
+    driver.run(_rebased(trace, engine.tick_count))
+    dt = time.perf_counter() - t0
+    ticks = engine.tick_count - t0_ticks
+    return dt / max(ticks, 1), ticks
+
+
+def _train_setup(n_steps: int):
+    """One warmed tiny-GPT streaming Estimator + its batches + start state."""
+    import jax
+    import numpy as np
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.estimator.config import RunConfig
+    from gradaccum_tpu.estimator.estimator import Estimator
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    rng = np.random.default_rng(7)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, size=(4, 16)).astype(np.int32)}
+    batches = [batch] * n_steps
+    est = Estimator(
+        bundle, gt.ops.sgd(0.01),
+        gt.GradAccumConfig(num_micro_batches=4),
+        RunConfig(model_dir=None, log_step_count_steps=10_000),
+        mode="streaming",
+    )
+    state = est.train(batches[:8])  # warmup: compile outside any window
+    # the streaming step donates its state buffers, so hand legs a HOST
+    # copy — each leg re-uploads a fresh device state before its timer
+    return est, batches, jax.device_get(state)
+
+
+def _train_leg(est, batches, host_state, tracer):
+    """Seconds per streaming train step under ``tracer`` (global slot)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gradaccum_tpu.obs import trace as obs_trace
+
+    state = jax.tree_util.tree_map(jnp.asarray, host_state)
+    with obs_trace.installed(tracer):
+        t0 = time.perf_counter()
+        est.train(batches, state=state)
+        dt = time.perf_counter() - t0
+    return dt / len(batches)
+
+
+def _workload(tracer):
+    """The emission workload a traced leg produced: one ``(ph, name, cat,
+    args)`` tuple per event, args without the injected ``seq``."""
+    out = []
+    for ev in tracer.snapshot():
+        args = {k: v for k, v in ev["args"].items() if k != "seq"}
+        out.append((ev["ph"], ev["name"], ev["cat"], args))
+    return out
+
+
+def _emission_cost(workload, repeats: int) -> float:
+    """Seconds to re-emit ``workload`` into a fresh recording tracer —
+    tight loop, min over repeats, so scheduler bursts cannot inflate it.
+    Spans replay as enter+exit back to back: exactly the tracer work the
+    traced leg paid (the span's held-open time is workload, not
+    overhead)."""
+    from gradaccum_tpu.obs.trace import Tracer
+
+    best = float("inf")
+    for _ in range(max(repeats, 3)):
+        tr = Tracer(capacity=None)
+        span = tr.span
+        event = tr.event
+        t0 = time.perf_counter()
+        for ph, name, cat, args in workload:
+            if ph == "X":
+                with span(name, cat, **args):
+                    pass
+            else:
+                event(name, cat, **args)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None,
+                    help="artifact path (default: <repo>/BENCH_obs.json)")
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--requests", type=int, default=96)
+    ap.add_argument("--train-steps", type=int, default=200)
+    args = ap.parse_args(argv)
+
+    from gradaccum_tpu.obs.trace import NullTracer, Tracer
+
+    makers = {"off": NullTracer, "on": lambda: Tracer(capacity=None)}
+    engine, driver, s_trace = _serve_setup(seed=100,
+                                           n_requests=args.requests)
+    est, batches, state = _train_setup(n_steps=args.train_steps)
+
+    # A/B wall-clock samples (cross-check only): each repeat runs both
+    # legs back to back on the SAME warmed engine/estimator, the leg
+    # ORDER flipping every repeat; the last "on" tracer of each hot path
+    # doubles as the emission-workload capture
+    serve = {k: {"samples": []} for k in makers}
+    train = {k: {"samples": []} for k in makers}
+    serve_tracer = train_tracer = None
+    for rep in range(args.repeats):
+        order = list(makers.items())
+        if rep % 2:
+            order.reverse()
+        for label, mk in order:
+            tracer = mk()
+            per_tick, ticks = _serve_leg(engine, driver, s_trace, tracer)
+            serve[label]["samples"].append(per_tick)
+            serve[label]["ticks"] = ticks
+            if label == "on":
+                serve_tracer = tracer
+        for label, mk in order:
+            tracer = mk()
+            train[label]["samples"].append(
+                _train_leg(est, batches, state, tracer)
+            )
+            if label == "on":
+                train_tracer = tracer
+    for label in makers:
+        serve[label]["s_per_tick"] = min(serve[label]["samples"])
+        train[label]["s_per_step"] = min(train[label]["samples"])
+        print(f"[obs-bench] serve {label}: "
+              f"{serve[label]['s_per_tick'] * 1e3:.3f} ms/tick, "
+              f"train {label}: "
+              f"{train[label]['s_per_step'] * 1e3:.4f} ms/step")
+
+    # the gating measurement: emission cost of the captured event stream
+    # over the uncontended baseline op time (both min-over-repeats)
+    serve_events = _workload(serve_tracer)
+    train_events = _workload(train_tracer)
+    serve_ticks = serve["on"]["ticks"]
+    serve_cost = _emission_cost(serve_events, args.repeats) / serve_ticks
+    train_cost = _emission_cost(train_events, args.repeats) / len(batches)
+    serve_ratio = 1.0 + serve_cost / serve["off"]["s_per_tick"]
+    train_ratio = 1.0 + train_cost / train["off"]["s_per_step"]
+    print(f"[obs-bench] serve: {len(serve_events)} events over "
+          f"{serve_ticks} ticks, {serve_cost * 1e6:.1f} us/tick emission; "
+          f"train: {len(train_events)} events over {len(batches)} steps, "
+          f"{train_cost * 1e6:.1f} us/step emission")
+
+    def _ab_ratio(d):
+        return min(d["on"]["samples"]) / min(d["off"]["samples"])
+
+    passed = serve_ratio <= 1.05 and train_ratio <= 1.05
+    headline = (f"obs overhead: serve {serve_ratio:.3f}x, "
+                f"train {train_ratio:.3f}x")
+    print(f"[obs-bench] {headline} "
+          f"(A/B wall cross-check: serve {_ab_ratio(serve):.3f}x, "
+          f"train {_ab_ratio(train):.3f}x) -> "
+          f"{'PASS' if passed else 'FAIL'}")
+
+    artifact = {
+        "bench": "observability overhead (spans+metrics on vs off, CPU)",
+        "headline": headline,
+        "serve": {
+            "events": len(serve_events),
+            "ticks": serve_ticks,
+            "emission_s_per_tick": serve_cost,
+            "baseline_s_per_tick": serve["off"]["s_per_tick"],
+            "overhead_ratio": serve_ratio,
+            "ab_wall": serve,
+        },
+        "train": {
+            "events": len(train_events),
+            "steps": len(batches),
+            "emission_s_per_step": train_cost,
+            "baseline_s_per_step": train["off"]["s_per_step"],
+            "overhead_ratio": train_ratio,
+            "ab_wall": train,
+        },
+        "repeats": args.repeats,
+        "acceptance": {"required": REQUIRED, "passed": passed},
+    }
+    out = args.json or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_obs.json",
+    )
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    print(f"[obs-bench] wrote {out}")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,7 @@ def collect(bench_dir: str):
                 data = json.load(f)
         except (OSError, json.JSONDecodeError) as e:
             rows.append({"file": name, "bench": f"<unreadable: {e}>",
+                         "headline": None,
                          "acceptance": {"required": "artifact must parse",
                                         "passed": False},
                          "passed": False})
@@ -41,6 +42,9 @@ def collect(bench_dir: str):
         rows.append({
             "file": name,
             "bench": data.get("bench") or data.get("metric") or "-",
+            # the one-line result an artifact chooses to lead with (e.g.
+            # BENCH_obs.json's measured overhead ratios)
+            "headline": data.get("headline"),
             "acceptance": acceptance,
             "passed": None if acceptance is None
             else bool(acceptance.get("passed")),
@@ -74,6 +78,8 @@ def main(argv=None) -> int:
             required = r["acceptance"].get("required") or \
                 r["acceptance"].get("required_speedup") or ""
             detail = f"{r['bench']}"
+            if r["headline"]:
+                detail += f" — {r['headline']}"
             if required != "":
                 detail += f" [{required}]"
             if not r["passed"]:

@@ -136,9 +136,40 @@ def _train_chaos(seed: int, work_dir: str, log):
              if scale_series[i] > scale_series[i - 1]]
     assert halves and grows, f"loss scale never cycled: {scale_series}"
     fired = [(p, i, k) for p, i, k in injector.fired]
+
+    # flight-recorder postmortems: every injected kill dumped the obs ring
+    # under model_dir/flightrec, and every fired fault is on the timeline
+    # with downstream activity after it (the resume is the effect)
+    from gradaccum_tpu.obs import flight as obs_flight
+    from gradaccum_tpu.obs import trace as obs_trace
+
+    dumps = obs_flight.list_dumps(work_dir)
+    assert len(dumps) >= crashes, \
+        f"{len(dumps)} flight dump(s) for {crashes} kill(s)"
+    dumped_faults = set()
+    for p in dumps:
+        dumped_faults |= set(
+            obs_flight.fault_events(obs_flight.load_dump(p)["events"])
+        )
+    events = obs_trace.get_tracer().snapshot()
+    ring_faults = set(obs_flight.fault_events(events))
+    missing = [f for f in fired if f not in (dumped_faults | ring_faults)]
+    assert not missing, f"faults missing from the obs ring: {missing}"
+    kill = (faults.POST_TRAIN_STEP, crash_at, faults.KIND_CRASH)
+    assert kill in dumped_faults, "the kill is absent from its own postmortem"
+    for point, index, kind in fired:
+        seq = next(e["args"]["seq"] for e in events
+                   if e["name"] == "fault/injected"
+                   and (e["args"]["point"], e["args"]["index"],
+                        e["args"]["kind"]) == (point, index, kind))
+        assert any(e["args"]["seq"] > seq and e["name"] == "train/step"
+                   for e in events), \
+            f"no post-fault train activity after {(point, index, kind)}"
     log(f"[chaos/train] PASS: {crashes} kill(s) survived, "
-        f"{len(fired)} faults fired, final ckpt step={ckpt_step}")
+        f"{len(fired)} faults fired ({len(dumps)} flight dumps), "
+        f"final ckpt step={ckpt_step}")
     return {"crashes": crashes, "faults_fired": fired,
+            "flight_dumps": len(dumps),
             "final_step": int(jax.device_get(state.step))}
 
 
@@ -176,12 +207,48 @@ def _serve_chaos(seed: int, log):
     ]
     log(f"[chaos/serve] plan: tick crash@{crash_tick}, "
         f"slow tick@{crash_tick + 3}")
+    import tempfile
+
+    from gradaccum_tpu.obs import flight as obs_flight
+    from gradaccum_tpu.obs import trace as obs_trace
+
     injector = FaultInjector(FaultSchedule(specs))
-    with faults.installed(injector):
-        server = ServingServer(engine, max_requeues=2).start()
+    with tempfile.TemporaryDirectory() as flight_dir, \
+            faults.installed(injector):
+        recorder = obs_flight.FlightRecorder(flight_dir,
+                                             registry=engine.metrics.registry)
+        server = ServingServer(engine, max_requeues=2,
+                               flight=recorder).start()
         handles = [server.submit(p, 5) for p in prompts]
         results = [h.result(timeout=120) for h in handles]
         server.stop()  # must not raise: the engine recovered
+
+        # the recovered tick crash shipped its own postmortem: a flight
+        # dump whose ring holds the injected fault AND its effect events
+        dumps = obs_flight.list_dumps(flight_dir)
+        assert dumps, "engine fault produced no flight dump"
+        dumped_faults = set()
+        for p in dumps:
+            dumped_faults |= set(
+                obs_flight.fault_events(obs_flight.load_dump(p)["events"])
+            )
+        crash_fault = (faults.MID_DECODE_TICK, crash_tick, faults.KIND_CRASH)
+        assert crash_fault in dumped_faults, \
+            "tick crash absent from its flight dump"
+        events = obs_trace.get_tracer().snapshot()
+        ring_faults = set(obs_flight.fault_events(events))
+        missing = [f for f in injector.fired
+                   if f not in (dumped_faults | ring_faults)]
+        assert not missing, f"faults missing from the obs ring: {missing}"
+        for point, index, kind in injector.fired:
+            seq = next(e["args"]["seq"] for e in events
+                       if e["name"] == "fault/injected"
+                       and (e["args"]["point"], e["args"]["index"],
+                            e["args"]["kind"]) == (point, index, kind))
+            assert any(e["args"]["seq"] > seq and e["cat"] == "serving"
+                       for e in events), \
+                f"no post-fault serving activity after {(point, index, kind)}"
+        n_flight_dumps = len(dumps)
 
     assert any(k == faults.KIND_CRASH for _, _, k in injector.fired), \
         "the seeded tick crash never fired"
@@ -193,8 +260,10 @@ def _serve_chaos(seed: int, log):
         )
     assert engine.idle
     log(f"[chaos/serve] PASS: {len(results)} requests completed with "
-        f"greedy parity through {len(injector.fired)} fault(s)")
+        f"greedy parity through {len(injector.fired)} fault(s), "
+        f"{n_flight_dumps} flight dump(s)")
     return {"requests": len(results),
+            "flight_dumps": n_flight_dumps,
             "faults_fired": list(injector.fired)}
 
 
@@ -210,13 +279,21 @@ def main(argv=None) -> int:
 
     required = ("seeded chaos (train kill+storm+ckpt IO, serve tick "
                 "crash+slow tick): clean resume, non-empty final "
-                "checkpoint, greedy serving parity")
+                "checkpoint, greedy serving parity, every injected fault "
+                "in a flight-recorder dump with downstream activity")
     passed = False
     detail = {}
+    from gradaccum_tpu.obs.trace import Tracer
+    from gradaccum_tpu.obs.trace import installed as tracer_installed
+
     try:
-        with tempfile.TemporaryDirectory() as work:
-            detail["train"] = _train_chaos(args.seed, work, log)
-        detail["serve"] = _serve_chaos(args.seed, log)
+        # one unbounded tracer across both phases: every fault, recover,
+        # resume and request lands on a single correlated timeline, and
+        # nothing is ring-evicted before the assertions read it back
+        with tracer_installed(Tracer(capacity=None)):
+            with tempfile.TemporaryDirectory() as work:
+                detail["train"] = _train_chaos(args.seed, work, log)
+            detail["serve"] = _serve_chaos(args.seed, log)
         passed = True
     except AssertionError as e:
         log(f"[chaos] FAIL: {e}")
