@@ -322,6 +322,14 @@ def decode_step_ragged(params, cfg: GPTConfig, cache: DecodeCache, token,
 # (XLA out-of-bounds scatter semantics), gather reads clamp to the last
 # block but land at virtual positions beyond the slot's length, which the
 # attention mask removes — so a sentinel can never corrupt or leak state.
+#
+# Blocks are also mutually INDEPENDENT — nothing below reads across the
+# block axis except through an explicit page-table gather — which is what
+# makes the pool legal to shard on that axis over a serving mesh
+# (``Engine(mesh=...)``): each chip holds ``num_blocks / tp`` blocks, page
+# tables and scatter/gather indices stay replicated host bookkeeping, and
+# GSPMD partitions these same jitted functions around the committed
+# placement (no code change on this side).
 
 
 def init_paged_pool(cfg: GPTConfig, num_blocks: int, page_size: int):

@@ -40,15 +40,32 @@ def _prom_name(name: str) -> str:
     return n
 
 
+def _label_str(labels: Optional[Dict[str, str]]) -> str:
+    """Prometheus-style rendering, '' when unlabeled. Sorted so the same
+    label set always produces the same instrument key."""
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _full_name(name: str, labels: Optional[Dict[str, str]]) -> str:
+    return name + _label_str(labels)
+
+
 class Counter:
     """Monotonic counter. Single-writer per subsystem by design (the
     serving engine is single-threaded; the train loop is one thread), so
-    ``inc`` stays a bare add on the hot path."""
+    ``inc`` stays a bare add on the hot path. ``labels`` is an optional
+    DIMENSION on the metric name (e.g. ``{"replica": "2"}``) — the same
+    base name with different labels is a different instrument, rendered
+    Prometheus-style on export."""
 
-    __slots__ = ("name", "value")
+    __slots__ = ("name", "value", "labels")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None):
         self.name = name
+        self.labels = labels
         self.value = 0
 
     def inc(self, n: int = 1) -> None:
@@ -58,10 +75,11 @@ class Counter:
 class Gauge:
     """Last-set value, plus the step it was set at (if any)."""
 
-    __slots__ = ("name", "value", "step")
+    __slots__ = ("name", "value", "step", "labels")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None):
         self.name = name
+        self.labels = labels
         self.value: Optional[float] = None
         self.step: Optional[int] = None
 
@@ -76,10 +94,12 @@ class Histogram:
     existing series to EXPOSE it (the serving metrics' TTFT series lands in
     the registry without double bookkeeping)."""
 
-    __slots__ = ("name", "series")
+    __slots__ = ("name", "series", "labels")
 
-    def __init__(self, name: str, series: Optional[LatencySeries] = None):
+    def __init__(self, name: str, series: Optional[LatencySeries] = None,
+                 labels: Optional[Dict[str, str]] = None):
         self.name = name
+        self.labels = labels
         self.series = series if series is not None else LatencySeries()
 
     def observe(self, x: float) -> None:
@@ -104,29 +124,35 @@ class MetricsRegistry:
 
     # -- instrument accessors (memoized; type conflicts are bugs) ---------
 
-    def counter(self, name: str) -> Counter:
+    def counter(self, name: str,
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        key = _full_name(name, labels)
         with self._lock:
-            c = self._counters.get(name)
+            c = self._counters.get(key)
             if c is None:
                 self._check_free(name, self._counters)
-                c = self._counters[name] = Counter(name)
+                c = self._counters[key] = Counter(name, labels)
             return c
 
-    def gauge(self, name: str) -> Gauge:
+    def gauge(self, name: str,
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        key = _full_name(name, labels)
         with self._lock:
-            g = self._gauges.get(name)
+            g = self._gauges.get(key)
             if g is None:
                 self._check_free(name, self._gauges)
-                g = self._gauges[name] = Gauge(name)
+                g = self._gauges[key] = Gauge(name, labels)
             return g
 
     def histogram(self, name: str,
-                  series: Optional[LatencySeries] = None) -> Histogram:
+                  series: Optional[LatencySeries] = None,
+                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+        key = _full_name(name, labels)
         with self._lock:
-            h = self._histograms.get(name)
+            h = self._histograms.get(key)
             if h is None:
                 self._check_free(name, self._histograms)
-                h = self._histograms[name] = Histogram(name, series)
+                h = self._histograms[key] = Histogram(name, series, labels)
             elif series is not None and h.series is not series:
                 # a rebuilt owner (e.g. a new ServingMetrics on a shared
                 # registry) re-registers its live series; rebind so exports
@@ -135,8 +161,13 @@ class MetricsRegistry:
             return h
 
     def _check_free(self, name: str, own: dict) -> None:
+        # a conflict is the same FAMILY (base name) under another type —
+        # compare instrument names, not the label-suffixed registry keys,
+        # or a labeled counter could shadow an unlabeled gauge and the
+        # export would merge both under one wrong TYPE line
         for kind in (self._counters, self._gauges, self._histograms):
-            if kind is not own and name in kind:
+            if kind is not own and any(i.name == name
+                                       for i in kind.values()):
                 raise ValueError(
                     f"metric {name!r} already registered as a different type"
                 )
@@ -151,12 +182,14 @@ class MetricsRegistry:
         self._writer = event_writer
 
     def publish(self, scalars: Dict[str, float], step: int,
-                subdir: Optional[str] = None) -> None:
+                subdir: Optional[str] = None,
+                labels: Optional[Dict[str, str]] = None) -> None:
         """Record ``scalars`` as gauges AND stream them to the EventWriter
         (when one is attached and active) — the one call replacing direct
-        ``EventWriter.scalars`` use."""
+        ``EventWriter.scalars`` use. ``labels`` lands on the gauges (a
+        replica's engine publishes the SAME gauge names, labeled)."""
         for tag, value in scalars.items():
-            self.gauge(tag).set(value, step=step)
+            self.gauge(tag, labels=labels).set(value, step=step)
         if self._writer is not None and self._writer.active:
             self._writer.scalars(
                 scalars, step=step,
@@ -191,23 +224,38 @@ class MetricsRegistry:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             hists = dict(self._histograms)
-        lines = []
-        for n, c in counters.items():
-            pn = _prom_name(n)
-            lines.append(f"# TYPE {pn} counter")
-            lines.append(f"{pn} {c.value}")
-        for n, g in gauges.items():
+        # the exposition format requires every sample of a metric family
+        # to form ONE contiguous group under its TYPE line — a fleet's
+        # replicas register the same base names interleaved, so bucket by
+        # family (first-registration order) before rendering
+        families: Dict[str, tuple] = {}
+
+        def bucket(pn: str, kind: str, rows) -> None:
+            fam = families.get(pn)
+            if fam is None:
+                fam = families[pn] = (kind, [])
+            fam[1].extend(rows)
+
+        for c in counters.values():
+            pn = _prom_name(c.name)
+            bucket(pn, "counter", [f"{pn}{_label_str(c.labels)} {c.value}"])
+        for g in gauges.values():
             if g.value is None:
                 continue
-            pn = _prom_name(n)
-            lines.append(f"# TYPE {pn} gauge")
-            lines.append(f"{pn} {g.value}")
-        for n, h in hists.items():
-            pn = _prom_name(n)
+            pn = _prom_name(g.name)
+            bucket(pn, "gauge", [f"{pn}{_label_str(g.labels)} {g.value}"])
+        for h in hists.values():
+            pn = _prom_name(h.name)
             s = h.summary()
-            lines.append(f"# TYPE {pn} summary")
+            rows = []
             for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
                 if s.get(key) is not None:
-                    lines.append(f'{pn}{{quantile="{q}"}} {s[key]}')
-            lines.append(f"{pn}_count {s['count']}")
+                    qlabels = dict(h.labels or {}, quantile=q)
+                    rows.append(f"{pn}{_label_str(qlabels)} {s[key]}")
+            rows.append(f"{pn}_count{_label_str(h.labels)} {s['count']}")
+            bucket(pn, "summary", rows)
+        lines = []
+        for pn, (kind, rows) in families.items():
+            lines.append(f"# TYPE {pn} {kind}")
+            lines.extend(rows)
         return "\n".join(lines) + ("\n" if lines else "")

@@ -28,6 +28,7 @@ from gradaccum_tpu.parallel.mesh import (
     data_parallel_mesh,
     initialize_multihost,
     make_mesh,
+    serving_mesh,
 )
 from gradaccum_tpu.parallel.ring_attention import (
     blockwise_attention,
@@ -43,5 +44,9 @@ from gradaccum_tpu.parallel.sharding import (
     shard_params,
 )
 from gradaccum_tpu.parallel.sp import make_dp_sp_train_step
-from gradaccum_tpu.parallel.tp import bert_tp_ep_rules, bert_tp_rules
+from gradaccum_tpu.parallel.tp import (
+    bert_tp_ep_rules,
+    bert_tp_rules,
+    gpt_tp_rules,
+)
 from gradaccum_tpu.parallel.ulysses import make_ulysses_attention_fn, ulysses_attention

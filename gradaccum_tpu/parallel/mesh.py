@@ -94,6 +94,26 @@ def data_parallel_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
     return make_mesh([(DATA_AXIS, len(devices))], devices=devices)
 
 
+def serving_mesh(tp: int = 1, devices=None) -> Mesh:
+    """Mesh for ONE serving-engine replica: just the ``model`` axis.
+
+    The serving stack spans chips along two independent axes — tensor
+    parallelism INSIDE a replica (this mesh: weights Megatron-sharded via
+    ``parallel.tp.gpt_tp_rules``, the paged KV pool split on its BLOCK
+    axis) and data parallelism ACROSS replicas
+    (``serving.ReplicatedEngine``, which carves ``jax.devices()`` into one
+    such mesh per replica). ``tp=1`` is a degenerate-but-useful mesh: it
+    pins a replica's whole engine to a single device, which is how
+    replicas land on distinct chips.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if not 1 <= tp <= len(devices):
+        raise ValueError(
+            f"serving mesh needs 1 <= tp <= {len(devices)} devices, got {tp}"
+        )
+    return make_mesh([(MODEL_AXIS, tp)], devices=devices[:tp])
+
+
 def make_hybrid_mesh(
     ici_axes: Sequence[Tuple[str, int]],
     dcn_axes: Sequence[Tuple[str, int]],

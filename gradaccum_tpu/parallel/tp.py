@@ -1,4 +1,5 @@
-"""Tensor-parallel sharding rules (Megatron-style) for the BERT encoder.
+"""Tensor-parallel sharding rules (Megatron-style) for the BERT encoder
+and the GPT decode path.
 
 The reference has no tensor parallelism (SURVEY.md §2 checklist) — this is a
 TPU-native extension: first-match regex rules mapping parameter names to
@@ -31,6 +32,26 @@ def bert_tp_rules(axis: str = MODEL_AXIS):
         # big embedding table: shard the vocab dim
         (r"word_embeddings/embedding", P(axis, None)),
     ]
+
+
+def gpt_tp_rules(axis: str = MODEL_AXIS):
+    """Rules for models/gpt.py / models/gpt_decode.py parameter names.
+
+    The GPT family deliberately reuses BERT's parameter naming
+    (``query/key/value``, ``intermediate``, ``ffn_output``,
+    ``word_embeddings`` — models/gpt.py:8-11), so the Megatron layout is
+    :func:`bert_tp_rules` verbatim; it is spelled as its own function
+    because the serving engine keys on it and the GPT tree's extra leaves
+    (``position_embeddings``, ``final_LayerNorm``) must stay replicated —
+    they match no rule, so first-match falls through to ``P()``.
+
+    The serving decode path consumes these rules directly
+    (``Engine(mesh=...)``): column-parallel QKV shards attention heads over
+    ``axis``, so each chip's decode tick projects and attends only its own
+    heads, and the row-parallel output/FFN matmuls all-reduce exactly as in
+    training — the train → serve handoff stays zero-copy under TP.
+    """
+    return bert_tp_rules(axis)
 
 
 def bert_tp_ep_rules(model_axis: str = MODEL_AXIS, expert_axis: str = EXPERT_AXIS):

@@ -18,7 +18,12 @@ page-aligned prompt chunks at admission) and prefill only their unshared
 tails. Admission
 control with backpressure and deadlines lives in ``scheduler``; a threaded
 front-end plus a deterministic seeded simulation driver in ``server``;
-TTFT / throughput / occupancy telemetry in ``metrics``.
+TTFT / throughput / occupancy telemetry in ``metrics``. Multi-chip spans
+two independent axes: ``Engine(mesh=...)`` tensor-shards one engine's
+compiled tick over a serving mesh (weights Megatron-style, the paged pool
+on its BLOCK axis), and ``ReplicatedEngine`` (``replicated``) places N
+data-parallel engines — least-loaded dispatch, prefix-affinity routing,
+per-replica failure domains — behind the same server surface.
 """
 
 from gradaccum_tpu.serving.cache_pool import (
@@ -28,6 +33,7 @@ from gradaccum_tpu.serving.cache_pool import (
 )
 from gradaccum_tpu.serving.engine import Engine, StepEvents
 from gradaccum_tpu.serving.metrics import ServingMetrics
+from gradaccum_tpu.serving.replicated import ReplicatedEngine
 from gradaccum_tpu.serving.scheduler import QueueFull, Request, Scheduler
 from gradaccum_tpu.serving.server import (
     ServingServer,
@@ -41,6 +47,7 @@ __all__ = [
     "PrefixCache",
     "Engine",
     "StepEvents",
+    "ReplicatedEngine",
     "ServingMetrics",
     "QueueFull",
     "Request",

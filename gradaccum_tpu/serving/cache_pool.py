@@ -48,6 +48,7 @@ from __future__ import annotations
 import hashlib
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -266,6 +267,10 @@ class PagedCachePool(_SlotLedger):
         self.page_table = np.full((num_slots, self.max_pages), num_blocks,
                                   np.int32)
         self._table_device: Optional[jnp.ndarray] = None
+        # a mesh engine pins the table's device placement (replicated over
+        # its serving mesh — page ids are host bookkeeping, never sharded);
+        # None keeps the default single-device upload
+        self.table_sharding = None
         self._free_blocks: List[int] = list(range(num_blocks - 1, -1, -1))
         self._slot_blocks: List[List[int]] = [[] for _ in range(num_slots)]
         self._slot_reserved = [0] * num_slots
@@ -418,5 +423,8 @@ class PagedCachePool(_SlotLedger):
         steady-state decode ticks reuse the same device buffer instead of
         paying a host→device transfer per tick."""
         if self._table_device is None:
-            self._table_device = jnp.asarray(self.page_table)
+            table = jnp.asarray(self.page_table)
+            if self.table_sharding is not None:
+                table = jax.device_put(table, self.table_sharding)
+            self._table_device = table
         return self._table_device

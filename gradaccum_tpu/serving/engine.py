@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gradaccum_tpu.models.gpt import GPTConfig
 from gradaccum_tpu.models.gpt_decode import (
@@ -233,6 +234,29 @@ class Engine:
     run out mid-stream — the engine refuses admission (and tells you it
     was BLOCKS, not slots) instead of preempting.
 
+    ``mesh`` spans ONE engine's compiled programs over multiple chips
+    (tensor parallelism): weights shard Megatron-style over the mesh's
+    ``model`` axis via :func:`~gradaccum_tpu.parallel.tp.gpt_tp_rules`
+    (heads column-parallel, FFN/output row-parallel, vocab-sharded
+    embedding), and the KV pool shards on an axis blocks make independent —
+    the paged pool's BLOCK axis (page tables, per-slot scatter/gather
+    indices, and the host-global reservation ledger are REPLICATED and
+    unchanged: block ids are data, never shapes), the fixed pool's HEAD
+    axis (each chip caches the heads its QKV shard produced). Sharding is
+    committed-input placement only — the tick/admit programs are the same
+    jitted functions, GSPMD partitions them — so the compile-once
+    invariants hold per mesh and greedy/seeded-sampled outputs stay
+    token-for-token identical to a single-chip engine (the multichip
+    parity gate).
+
+    ``replica_id`` names this engine inside a
+    :class:`~gradaccum_tpu.serving.replicated.ReplicatedEngine` fleet:
+    backpressure messages and admission-stall keys carry "replica N", obs
+    spans and metrics gain the replica dimension, and ``id_start`` /
+    ``id_stride`` give each replica a disjoint request-id lattice
+    (``rid % replicas == replica_id``) so ids stay globally unique behind
+    one server.
+
     ``prefix_cache`` (paged mode only; ``True`` or a
     :class:`~gradaccum_tpu.serving.cache_pool.PrefixCache`) turns on
     SHARED-PREFIX admission: page-aligned prompt chunks are hashed at
@@ -265,6 +289,10 @@ class Engine:
         profile_start_tick: int = 0,
         profile_num_ticks: int = 0,
         tracer=None,
+        mesh: Optional[Mesh] = None,
+        replica_id: Optional[int] = None,
+        id_start: int = 0,
+        id_stride: int = 1,
     ):
         if top_k is not None and temperature <= 0:
             raise ValueError("top_k sampling needs temperature > 0 "
@@ -275,6 +303,8 @@ class Engine:
             raise ValueError(f"decode_block must be >= 1, got {decode_block}")
         if num_blocks is not None and page_size is None:
             raise ValueError("num_blocks needs page_size (paged mode)")
+        if id_stride < 1:
+            raise ValueError(f"id_stride must be >= 1, got {id_stride}")
         self.params = params
         self.cfg = cfg
         self.max_len = max_len
@@ -305,6 +335,45 @@ class Engine:
             self.prefix_cache = None
             self.num_blocks = None
             self.pool = CachePool(cfg, num_slots, max_len)
+        self.mesh = mesh
+        self.replica_id = None if replica_id is None else int(replica_id)
+        if mesh is not None:
+            from gradaccum_tpu.parallel.mesh import MODEL_AXIS
+            from gradaccum_tpu.parallel.sharding import shard_params
+            from gradaccum_tpu.parallel.tp import gpt_tp_rules
+
+            if MODEL_AXIS not in mesh.axis_names:
+                raise ValueError(
+                    f"serving mesh needs a '{MODEL_AXIS}' axis, got "
+                    f"{mesh.axis_names} (parallel.mesh.serving_mesh builds "
+                    "one)"
+                )
+            tp = int(mesh.shape[MODEL_AXIS])
+            for what, dim in (("num_heads", cfg.num_heads),
+                              ("intermediate_size", cfg.intermediate_size),
+                              ("vocab_size", cfg.vocab_size)):
+                if dim % tp:
+                    raise ValueError(
+                        f"cfg.{what}={dim} not divisible by the model axis "
+                        f"({tp}) — gpt_tp_rules shards it"
+                    )
+            if self.paged and self.num_blocks % tp:
+                raise ValueError(
+                    f"num_blocks {self.num_blocks} not divisible by the "
+                    f"model axis ({tp}) — the paged pool shards its BLOCK "
+                    "axis"
+                )
+            self.params = shard_params(params, mesh, gpt_tp_rules())
+        # replica/mesh attribution spread into spans and flight dumps; {}
+        # for a plain single-chip engine, so the obs determinism gate and
+        # existing dashboards see byte-identical output
+        self._obs_args: Dict[str, object] = {}
+        if self.replica_id is not None:
+            self._obs_args["replica"] = self.replica_id
+        if mesh is not None:
+            self._obs_args["mesh"] = ",".join(
+                f"{n}={mesh.shape[n]}" for n in mesh.axis_names
+            )
         # prefix matches found by this tick's admission gate, consumed by
         # _admit (request_id -> shared block ids)
         self._pending_match: Dict[int, List[int]] = {}
@@ -314,7 +383,12 @@ class Engine:
         # value only names the scarce resource in an exception message
         self._head_match_memo: Optional[Tuple[int, int]] = None
         self.scheduler = scheduler or Scheduler()
-        self.metrics = metrics or ServingMetrics()
+        if self.replica_id is not None and self.scheduler.label is None:
+            # stall keys name the saturated replica ("replica 2:
+            # no_free_blocks") — which engine of a fleet is starved is the
+            # whole diagnosis once replicas are layered
+            self.scheduler.label = f"replica {self.replica_id}"
+        self.metrics = metrics or ServingMetrics(replica_id=self.replica_id)
         # obs: request-lifecycle spans + tick spans land in this tracer —
         # an injected one (the sim driver rewires a deterministic tracer's
         # clock to the tick counter), or the process-global ring RESOLVED
@@ -346,6 +420,8 @@ class Engine:
         self._limit = jnp.zeros((num_slots,), jnp.int32)
         self._slot_len = np.zeros((num_slots,), np.int64)
         self._slot_limit = np.zeros((num_slots,), np.int64)
+        if mesh is not None:
+            self._apply_mesh()
 
         if decode_block_set is not None:
             blocks = sorted({int(b) for b in decode_block_set})
@@ -380,13 +456,47 @@ class Engine:
             self._admit_fn = _make_admit_fn(cfg, self.temperature, self.top_k,
                                             max_len)
         self._tick = 0
-        self._next_id = 0
+        self._next_id = int(id_start)
+        self._id_stride = int(id_stride)
         # per-request outputs; long-running front-ends MUST evict via
         # pop_result() once consumed or host memory grows with traffic
         self.results: Dict[int, List[int]] = {}
         self.status: Dict[int, str] = {}
 
+    def _apply_mesh(self) -> None:
+        """Commit the pool + per-slot device state onto the serving mesh.
+
+        The KV arrays shard on the axis their entries make independent —
+        paged pool ``[L, BLOCKS, H, P, hd]`` on BLOCKS, fixed pool
+        ``[L, S, HEADS, T, hd]`` on HEADS — everything else replicates.
+        Input placement is the whole mechanism: the jitted tick/admit
+        programs are untouched and GSPMD partitions them around these
+        committed shardings, so each program still compiles once per mesh.
+        Re-run after :meth:`recover` rebuilds the pool (fresh arrays land
+        unsharded otherwise)."""
+        from gradaccum_tpu.parallel.mesh import MODEL_AXIS
+
+        mesh = self.mesh
+        rep = NamedSharding(mesh, P())
+        if self.paged:
+            kv = NamedSharding(mesh, P(None, MODEL_AXIS))
+            self.pool.table_sharding = rep
+        else:
+            kv = NamedSharding(mesh, P(None, None, MODEL_AXIS))
+        self.pool.k = jax.device_put(self.pool.k, kv)
+        self.pool.v = jax.device_put(self.pool.v, kv)
+        self.pool.lengths = jax.device_put(self.pool.lengths, rep)
+        self._cur_tok = jax.device_put(self._cur_tok, rep)
+        self._gen = jax.device_put(self._gen, rep)
+        self._rngs = jax.device_put(self._rngs, rep)
+        self._limit = jax.device_put(self._limit, rep)
+
     # -- introspection ----------------------------------------------------
+
+    def obs_tags(self) -> dict:
+        """Replica/mesh attribution for spans and flight dumps ({} on a
+        plain single-chip engine)."""
+        return dict(self._obs_args)
 
     @property
     def tracer(self):
@@ -438,6 +548,10 @@ class Engine:
             "temperature": self.temperature,
             "top_k": self.top_k,
             "min_prefill_bucket": self.min_prefill_bucket,
+            "mesh": (None if self.mesh is None
+                     else {n: int(self.mesh.shape[n])
+                           for n in self.mesh.axis_names}),
+            "replica_id": self.replica_id,
         }
 
     # -- request intake ---------------------------------------------------
@@ -449,6 +563,7 @@ class Engine:
         eos_id: Optional[int] = None,
         rng_seed: int = 0,
         deadline_ticks: Optional[int] = None,
+        _quiet_full: bool = False,
     ) -> int:
         """Queue one request; returns its id. Raises
         :class:`~gradaccum_tpu.serving.scheduler.QueueFull` on backpressure
@@ -471,7 +586,7 @@ class Engine:
                     f"{self.pool.num_blocks} — it could never be admitted"
                 )
         rid = self._next_id
-        self._next_id += 1
+        self._next_id += self._id_stride
         req = Request(
             request_id=rid,
             prompt=prompt,
@@ -486,11 +601,18 @@ class Engine:
         try:
             self.scheduler.submit(req)
         except QueueFull as e:
-            self.metrics.record_reject(rid)
             bottleneck = self._bottleneck()
+            if _quiet_full:
+                # fleet fall-through probe: the request will be retried on
+                # another replica, so this is not a client-visible
+                # rejection — no reject telemetry, and the lattice id is
+                # handed back so probes don't burn it
+                self._next_id = rid
+                raise QueueFull(f"{e}; bottleneck: {bottleneck}") from None
+            self.metrics.record_reject(rid)
             if tr.enabled:
                 tr.event("req/reject", cat="request", rid=rid,
-                         bottleneck=bottleneck)
+                         bottleneck=bottleneck, **self._obs_args)
             # backpressure names the scarce resource: operators grow slots
             # and KV blocks independently, so "which one ran out" is the
             # whole diagnosis
@@ -505,7 +627,7 @@ class Engine:
             self._req_submit_ts[rid] = tr.now()
             tr.event("req/submit", cat="request", rid=rid,
                      prompt_len=int(prompt.size),
-                     max_new=int(max_new_tokens))
+                     max_new=int(max_new_tokens), **self._obs_args)
         return rid
 
     # -- the tick ---------------------------------------------------------
@@ -522,9 +644,14 @@ class Engine:
         return self.decode_block_set[-1]
 
     def _bottleneck(self) -> str:
-        """Which pool resource is exhausted right now (backpressure detail)."""
+        """Which pool resource is exhausted right now (backpressure
+        detail). Behind a replica fleet the message also names WHICH
+        engine is saturated ("replica 2: no free KV blocks") — a plain
+        single-chip engine's text is unchanged."""
+        tag = ("" if self.replica_id is None
+               else f"replica {self.replica_id}: ")
         if self.pool.free_count == 0:
-            return "no free slots"
+            return tag + "no free slots"
         if self.paged:
             # judge by what admission would actually ask for: the queue
             # head's reservation — only its UNSHARED blocks when the prefix
@@ -543,8 +670,8 @@ class Engine:
             else:
                 need = 1
             if need > self.pool.unreserved_blocks:
-                return "no free KV blocks"
-        return "queue backlog (slots available)"
+                return tag + "no free KV blocks"
+        return tag + "queue backlog (slots available)"
 
     @property
     def _token_bytes(self) -> int:
@@ -562,7 +689,8 @@ class Engine:
         tr = self.tracer
         if not tr.enabled:
             return self._step()
-        with tr.span("serve/tick", cat="serving", tick=self._tick) as sp:
+        with tr.span("serve/tick", cat="serving", tick=self._tick,
+                     **self._obs_args) as sp:
             events = self._step()
             sp.set(admitted=len(events.admitted),
                    emitted=len(events.emitted),
@@ -586,7 +714,8 @@ class Engine:
             ts0 = self._req_submit_ts.pop(req.request_id, None)
             if tr.enabled and ts0 is not None:
                 tr.complete("req/queue", ts0, cat="request",
-                            rid=req.request_id, outcome="timeout")
+                            rid=req.request_id, outcome="timeout",
+                            **self._obs_args)
 
         fits = None
         if self.paged:
@@ -733,7 +862,8 @@ class Engine:
             ts0 = self._req_submit_ts.pop(request_id, None)
             if tr.enabled and ts0 is not None:
                 tr.complete("req/queue", ts0, cat="request",
-                            rid=request_id, outcome="cancelled")
+                            rid=request_id, outcome="cancelled",
+                            **self._obs_args)
             return True
         for slot, req in enumerate(self._slot_req):
             if req is not None and req.request_id == request_id:
@@ -747,7 +877,8 @@ class Engine:
                 ts0 = self._req_admit_ts.pop(request_id, None)
                 if tr.enabled and ts0 is not None:
                     tr.complete("req/decode", ts0, cat="request",
-                                rid=request_id, outcome="cancelled")
+                                rid=request_id, outcome="cancelled",
+                                **self._obs_args)
                 return True
         return False
 
@@ -781,7 +912,8 @@ class Engine:
             ts0 = self._req_admit_ts.pop(req.request_id, None)
             if tr.enabled and ts0 is not None:
                 tr.complete("req/decode", ts0, cat="request",
-                            rid=req.request_id, outcome="error")
+                            rid=req.request_id, outcome="error",
+                            **self._obs_args)
         device_arrays = (self.pool.k, self.pool.v, self.pool.lengths,
                          self._cur_tok, self._gen, self._rngs, self._limit)
         if any(getattr(a, "is_deleted", lambda: False)() for a in device_arrays):
@@ -804,12 +936,15 @@ class Engine:
             self._limit = jnp.zeros((num_slots,), jnp.int32)
             self._slot_len[:] = 0
             self._slot_limit[:] = 0
+            if self.mesh is not None:
+                self._apply_mesh()
             rebuilt = True
         else:
             rebuilt = False
         if tr.enabled:
             tr.event("serve/recover", cat="resilience", tick=self._tick,
-                     failed=len(failed), pool_rebuilt=rebuilt)
+                     failed=len(failed), pool_rebuilt=rebuilt,
+                     **self._obs_args)
         return failed
 
     def run_until_idle(self, max_ticks: int = 100_000) -> List[StepEvents]:
@@ -844,7 +979,8 @@ class Engine:
             if enabled:
                 if ts0 is not None:
                     tr.complete("req/queue", ts0, cat="request",
-                                rid=r.request_id, outcome="admitted")
+                                rid=r.request_id, outcome="admitted",
+                                **self._obs_args)
                 self._req_admit_ts[r.request_id] = now
         slots = self.pool.claim_many(len(reqs))
         assert len(slots) == len(reqs), "scheduler admitted beyond free slots"
@@ -969,7 +1105,7 @@ class Engine:
                 tr.event("req/admit", cat="request", rid=r.request_id,
                          computed_tokens=int(tails[i]),
                          skipped_tokens=int(skipped),
-                         shared_blocks=int(n_shared))
+                         shared_blocks=int(n_shared), **self._obs_args)
         self.pool.set_arrays(k, v, lengths)
         tok0_host = np.asarray(jax.device_get(tok0))
         for slot, req, tok in zip(slots, reqs, tok0_host):
@@ -1001,4 +1137,5 @@ class Engine:
             ts0 = self._req_admit_ts.pop(rid, None)
             if tr.enabled and ts0 is not None:
                 tr.complete("req/decode", ts0, cat="request", rid=rid,
-                            outcome=reason, tokens=len(out))
+                            outcome=reason, tokens=len(out),
+                            **self._obs_args)

@@ -34,8 +34,17 @@ class ServingMetrics:
         subdir: str = "serving",
         clock: Callable[[], float] = time.monotonic,
         registry: Optional[MetricsRegistry] = None,
+        replica_id: Optional[int] = None,
     ):
         self.clock = clock
+        # replica_id puts a REPLICA DIMENSION on the existing instruments
+        # (same gauge/counter names, labeled {replica="N"}) instead of
+        # minting per-replica scalar names — so a ReplicatedEngine fleet
+        # can share ONE registry and one Prometheus endpoint shows every
+        # replica side by side. None leaves every name exactly as before.
+        self.replica_id = None if replica_id is None else int(replica_id)
+        self._labels = (None if self.replica_id is None
+                        else {"replica": str(self.replica_id)})
         self.registry = registry if registry is not None else \
             MetricsRegistry(event_writer=event_writer, subdir=subdir)
         self.ttft = LatencySeries()          # submit -> first token
@@ -78,13 +87,15 @@ class ServingMetrics:
             ("serving/queue_depth_series", self.queue_depth),
             ("serving/occupancy_series", self.occupancy),
         ):
-            reg.histogram(name, series=series)
-        self._c_tokens = reg.counter("serving/tokens_emitted_total")
-        self._c_rejected = reg.counter("serving/rejected_total")
+            reg.histogram(name, series=series, labels=self._labels)
+        self._c_tokens = reg.counter("serving/tokens_emitted_total",
+                                     labels=self._labels)
+        self._c_rejected = reg.counter("serving/rejected_total",
+                                       labels=self._labels)
         self._c_prefill_computed = reg.counter(
-            "serving/prefill_tokens_computed_total")
+            "serving/prefill_tokens_computed_total", labels=self._labels)
         self._c_prefill_skipped = reg.counter(
-            "serving/prefill_tokens_skipped_total")
+            "serving/prefill_tokens_skipped_total", labels=self._labels)
 
     # -- per-request lifecycle -------------------------------------------
 
@@ -110,7 +121,8 @@ class ServingMetrics:
 
     def record_finish(self, request_id: int, reason: str) -> None:
         self.finished[reason] = self.finished.get(reason, 0) + 1
-        self.registry.counter(f"serving/finished_{reason}_total").inc()
+        self.registry.counter(f"serving/finished_{reason}_total",
+                              labels=self._labels).inc()
         self._submit_t.pop(request_id, None)
         self._last_token_t.pop(request_id, None)
 
@@ -180,8 +192,8 @@ class ServingMetrics:
                 self.shared_blocks_peak = shared_blocks
             scalars["serving/shared_kv_blocks"] = float(shared_blocks)
         # one call: records every scalar as a registry gauge AND streams to
-        # the EventWriter when one is attached
-        self.registry.publish(scalars, step=self.ticks)
+        # the EventWriter when one is attached (replica-labeled in a fleet)
+        self.registry.publish(scalars, step=self.ticks, labels=self._labels)
 
     # -- summary ----------------------------------------------------------
 
@@ -205,6 +217,7 @@ class ServingMetrics:
 
     def summary(self) -> dict:
         return {
+            "replica_id": self.replica_id,
             "ttft": self.ttft.summary(),
             "token_latency": self.token_latency.summary(),
             "queue_depth": self.queue_depth.summary(),

@@ -1,0 +1,450 @@
+"""Data-parallel serving: N independent engines behind one front door.
+
+Tensor parallelism (``Engine(mesh=...)``) makes ONE decode tick span
+chips; this module is the other axis: a :class:`ReplicatedEngine` places
+``replicas`` fully independent :class:`~gradaccum_tpu.serving.engine.
+Engine` instances — each with its own KV pool, scheduler, and (optional)
+serving mesh carved out of ``jax.devices()`` — behind the exact interface
+the :class:`~gradaccum_tpu.serving.server.ServingServer` and
+:class:`~gradaccum_tpu.serving.server.SimulationDriver` already speak, so
+the threaded front-end and the deterministic test harness work unchanged
+while aggregate tokens/s scales with replica count.
+
+Design points:
+
+- **Disjoint id lattices.** Replica ``i`` allocates request ids
+  ``i, i+N, i+2N, ...`` (``Engine(id_start=i, id_stride=N)``), so ids are
+  globally unique and ``rid % N`` IS the routing table — no id map to
+  keep consistent across faults.
+- **Least-loaded dispatch with prefix affinity.** A submit goes to the
+  replica whose prefix cache holds the LONGEST live match for the prompt
+  (shared-system-prompt traffic keeps hitting the replica that owns the
+  blocks — per-replica caches never degrade to cold misses), ties broken
+  by load (queue depth + active slots), then replica index. A saturated
+  pick falls through to the next candidate; only when EVERY replica
+  rejects does :class:`~gradaccum_tpu.serving.scheduler.QueueFull`
+  propagate — carrying the best replica's "replica N: ..." bottleneck.
+- **Concurrent ticks.** ``step()`` runs every replica's tick on a small
+  thread pool (each thread touches only its own engine, which is exactly
+  the granularity Engine's not-thread-safe contract requires); replica
+  ticks are real parallelism on multi-device hosts, which is where the
+  1→N tokens/s curve in BENCH_serving_mp.json comes from.
+- **Per-replica failure domain.** A tick that faults on SOME replicas
+  re-raises (the PR-2 server contract: recover → bounded requeue), but
+  ``recover()`` resets only the replicas that actually faulted — healthy
+  replicas keep their in-flight requests, and their events from the
+  faulted tick are buffered and delivered with the next clean tick
+  (filtered against results the fault handler already reconciled), so no
+  stream loses tokens to a neighbor's crash.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Set
+
+import jax
+import numpy as np
+
+from gradaccum_tpu.models.gpt import GPTConfig
+from gradaccum_tpu.obs import trace as obs_trace
+from gradaccum_tpu.serving.engine import Engine, StepEvents
+from gradaccum_tpu.serving.metrics import ServingMetrics
+from gradaccum_tpu.serving.scheduler import QueueFull, Request, Scheduler
+
+
+class _FleetDict:
+    """Routes rid-keyed dict access to the owning replica's dict
+    (``rid % N`` — the id-lattice invariant). Covers the operations the
+    server/driver/tests actually perform on ``engine.results`` /
+    ``engine.status``."""
+
+    def __init__(self, engines: List[Engine], attr: str):
+        self._engines = engines
+        self._attr = attr
+
+    def _d(self, rid: int) -> Dict:
+        return getattr(self._engines[int(rid) % len(self._engines)],
+                       self._attr)
+
+    def get(self, rid, default=None):
+        return self._d(rid).get(rid, default)
+
+    def pop(self, rid, *default):
+        return self._d(rid).pop(rid, *default)
+
+    def __getitem__(self, rid):
+        return self._d(rid)[rid]
+
+    def __setitem__(self, rid, value):
+        self._d(rid)[rid] = value
+
+    def __contains__(self, rid) -> bool:
+        return rid in self._d(rid)
+
+    def __len__(self) -> int:
+        return sum(len(getattr(e, self._attr)) for e in self._engines)
+
+    def keys(self):
+        ks = []
+        for e in self._engines:
+            ks.extend(getattr(e, self._attr).keys())
+        return ks
+
+    def values(self):
+        vs = []
+        for e in self._engines:
+            vs.extend(getattr(e, self._attr).values())
+        return vs
+
+    def items(self):
+        its = []
+        for e in self._engines:
+            its.extend(getattr(e, self._attr).items())
+        return its
+
+    def __iter__(self):
+        # without this, iteration falls into the legacy __getitem__
+        # protocol and yields VALUES for rids 0.. until a KeyError —
+        # callers written against the dict-typed Engine surface must get
+        # the rid keys
+        return iter(self.keys())
+
+
+class _FleetMetrics:
+    """Aggregate metrics facade: the SimulationDriver rewires ``clock``
+    (propagated to every replica, so TTFT/latency come out on ONE logical
+    tick clock) and operators read ``summary()`` — per-replica blocks
+    plus fleet totals. All replicas share one registry, so
+    ``to_prometheus()`` is the whole fleet with replica labels."""
+
+    def __init__(self, fleet: "ReplicatedEngine"):
+        self._fleet = fleet
+
+    @property
+    def clock(self):
+        return self._fleet.replicas[0].metrics.clock
+
+    @clock.setter
+    def clock(self, fn) -> None:
+        for e in self._fleet.replicas:
+            e.metrics.clock = fn
+
+    def summary(self) -> dict:
+        per = [e.metrics.summary() for e in self._fleet.replicas]
+        return {
+            "replicas": len(per),
+            "tokens_emitted": sum(p["tokens_emitted"] for p in per),
+            "rejected": sum(p["rejected"] for p in per),
+            "finished": _sum_dicts(p["finished"] for p in per),
+            "per_replica": per,
+        }
+
+    def to_prometheus(self) -> str:
+        return self._fleet.registry.to_prometheus()
+
+    def flush(self) -> None:
+        for e in self._fleet.replicas:
+            e.metrics.flush()
+
+
+def _sum_dicts(dicts) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for d in dicts:
+        for k, v in d.items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+class ReplicatedEngine:
+    """N data-parallel :class:`Engine` replicas behind one Engine-shaped
+    interface.
+
+    ``tp`` chips per replica: ``jax.devices()`` (or ``devices=``) is
+    carved into ``replicas`` groups of ``tp``, each group becoming that
+    replica's :func:`~gradaccum_tpu.parallel.mesh.serving_mesh` — so
+    ``replicas=4, tp=2`` is the full two-axis layout on 8 chips. With
+    ``tp=1`` and fewer devices than replicas, replicas round-robin onto
+    the devices that exist (they still run, they just share chips);
+    ``tp=None`` skips meshes entirely (every replica on the default
+    device — the degenerate all-host layout).
+
+    ``engine_kwargs`` go to every replica verbatim (num_slots, max_len,
+    page_size, prefix_cache, ...); each replica gets its OWN scheduler
+    (``scheduler_factory`` to customize) and its own
+    :class:`ServingMetrics` bound to one shared registry with a
+    ``replica`` label.
+    """
+
+    def __init__(
+        self,
+        params,
+        cfg: GPTConfig,
+        replicas: int = 2,
+        tp: Optional[int] = 1,
+        devices=None,
+        scheduler_factory=None,
+        tracer=None,
+        **engine_kwargs,
+    ):
+        if replicas < 1:
+            raise ValueError(f"need at least one replica, got {replicas}")
+        for k in ("mesh", "replica_id", "id_start", "id_stride", "scheduler",
+                  "metrics"):
+            if k in engine_kwargs:
+                raise ValueError(f"{k!r} is managed per replica — pass "
+                                 "ReplicatedEngine-level knobs instead")
+        from gradaccum_tpu.obs.metrics import MetricsRegistry
+        from gradaccum_tpu.parallel.mesh import serving_mesh
+
+        devices = list(jax.devices()) if devices is None else list(devices)
+        self.cfg = cfg
+        self._tracer = tracer
+        self.registry = MetricsRegistry(subdir="serving")
+        self.metrics = _FleetMetrics(self)
+        self.replicas: List[Engine] = []
+        self.tp = tp
+        for i in range(replicas):
+            if tp is None:
+                mesh = None
+            elif replicas * tp <= len(devices):
+                mesh = serving_mesh(tp, devices=devices[i * tp:(i + 1) * tp])
+            elif tp == 1:
+                # more replicas than devices: share chips round-robin
+                # rather than refusing to run (CPU hosts, small dev boxes)
+                mesh = serving_mesh(1, devices=[devices[i % len(devices)]])
+            else:
+                raise ValueError(
+                    f"replicas={replicas} x tp={tp} needs "
+                    f"{replicas * tp} devices, have {len(devices)}"
+                )
+            sched = (scheduler_factory() if scheduler_factory is not None
+                     else Scheduler())
+            self.replicas.append(Engine(
+                params, cfg, mesh=mesh, replica_id=i,
+                id_start=i, id_stride=replicas, scheduler=sched,
+                metrics=ServingMetrics(registry=self.registry, replica_id=i),
+                tracer=tracer, **engine_kwargs,
+            ))
+        self.results = _FleetDict(self.replicas, "results")
+        self.status = _FleetDict(self.replicas, "status")
+        self._tick = 0
+        self._faulted: Set[int] = set()
+        # healthy replicas' events from a partially-faulted tick, delivered
+        # with the next clean tick (see step())
+        self._held: List[StepEvents] = []
+        self._pool = (ThreadPoolExecutor(
+            max_workers=replicas, thread_name_prefix="serving-replica")
+            if replicas > 1 else None)
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def tracer(self):
+        return obs_trace.resolve(self._tracer)
+
+    @property
+    def idle(self) -> bool:
+        return all(e.idle for e in self.replicas) and not self._held
+
+    @property
+    def tick_count(self) -> int:
+        return self._tick
+
+    @property
+    def paged(self) -> bool:
+        return self.replicas[0].paged
+
+    @property
+    def prefix_cache(self):
+        return self.replicas[0].prefix_cache
+
+    @property
+    def max_len(self) -> int:
+        return self.replicas[0].max_len
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(e.scheduler.depth for e in self.replicas)
+
+    def decode_compile_count(self) -> int:
+        """Fleet total. The per-replica bound is the invariant — each
+        replica compiles its own program set once, checked replica by
+        replica in the multichip gates."""
+        return sum(e.decode_compile_count() for e in self.replicas)
+
+    def prefill_compile_count(self) -> int:
+        return sum(e.prefill_compile_count() for e in self.replicas)
+
+    def obs_tags(self) -> dict:
+        tags = {"replicas": len(self.replicas)}
+        mesh = self.replicas[0].mesh
+        if mesh is not None:
+            tags["mesh"] = ",".join(f"{n}={mesh.shape[n]}"
+                                    for n in mesh.axis_names)
+        return tags
+
+    def manifest(self) -> dict:
+        """Fleet shape for the export manifest: replica count, mesh axes,
+        and every replica's full knob set (per-replica paging included)."""
+        mesh = self.replicas[0].mesh
+        return {
+            "replicas": len(self.replicas),
+            "tp": self.tp,
+            "mesh": (None if mesh is None
+                     else {n: int(mesh.shape[n]) for n in mesh.axis_names}),
+            "engines": [e.manifest() for e in self.replicas],
+        }
+
+    # -- request intake ----------------------------------------------------
+
+    def _candidates(self, prompt: np.ndarray) -> List[int]:
+        """Replica indices in dispatch order: longest live prefix match
+        first (affinity — the blocks are THERE, a different replica would
+        cold-miss), then least loaded, then lowest index (determinism)."""
+        keys = []
+        for i, e in enumerate(self.replicas):
+            shared = 0
+            if e.prefix_cache is not None and prompt.size > e.page_size:
+                shared = len(e.prefix_cache.match(prompt))
+            load = e.scheduler.depth + e.pool.active_count
+            keys.append((-shared, load, i))
+        return [i for _, _, i in sorted(keys)]
+
+    def submit(self, prompt, max_new_tokens: int,
+               eos_id: Optional[int] = None, rng_seed: int = 0,
+               deadline_ticks: Optional[int] = None) -> int:
+        """Dispatch to the best replica; falls through the candidate order
+        on backpressure and re-raises the BEST replica's QueueFull (its
+        message names the saturated replica) only when every replica is
+        full. Validation errors (never-fitting request) propagate
+        immediately — no replica could take it."""
+        arr = np.asarray(prompt, np.int32).reshape(-1)
+        order = self._candidates(arr)
+        for idx in order:
+            try:
+                return self.replicas[idx].submit(
+                    prompt, max_new_tokens, eos_id=eos_id, rng_seed=rng_seed,
+                    deadline_ticks=deadline_ticks, _quiet_full=True,
+                )
+            except QueueFull:
+                continue
+        # every replica refused: resubmit to the best candidate WITHOUT
+        # the quiet flag so exactly ONE client-visible rejection lands in
+        # telemetry — the probe attempts above record none, keeping
+        # rejected_total an honest count of requests clients lost
+        return self.replicas[order[0]].submit(
+            prompt, max_new_tokens, eos_id=eos_id, rng_seed=rng_seed,
+            deadline_ticks=deadline_ticks,
+        )
+
+    # -- the tick ----------------------------------------------------------
+
+    def step(self) -> StepEvents:
+        """One fleet tick: every replica ticks once (concurrently when
+        there are several), events merged in replica order. Held events
+        from a previous partially-faulted tick are delivered first,
+        filtered to requests whose results the fault handler has not
+        already reconciled away."""
+        t = self._tick
+        if self._pool is None:
+            evs = [self.replicas[0].step()]
+        else:
+            tr = self.tracer
+            if tr.enabled and getattr(tr, "deterministic", False):
+                # a deterministic tracer promises byte-identical event
+                # order across seeded runs; racing replica threads into
+                # the one shared ring would break it — tick sequentially
+                waits = [e.step for e in self.replicas]
+            else:
+                futures = [self._pool.submit(e.step) for e in self.replicas]
+                waits = [f.result for f in futures]
+            evs, errors = [], []
+            for i, w in enumerate(waits):
+                try:
+                    evs.append(w())
+                except Exception as exc:  # noqa: BLE001 — re-raised below
+                    errors.append(exc)
+                    self._faulted.add(i)
+            if errors:
+                # healthy replicas' events must not vanish with the
+                # neighbor's exception: hold them for the next clean tick
+                self._held.extend(evs)
+                raise errors[0]
+        emitted, finished, admitted = [], [], []
+        tagged = [(True, ev) for ev in self._held] + \
+                 [(False, ev) for ev in evs]
+        for held, ev in tagged:
+            for rid, tok in ev.emitted:
+                if held and rid not in self.results:
+                    continue  # reconciled by the fault handler already
+                emitted.append((rid, tok))
+            for rid, reason in ev.finished:
+                if held and rid not in self.results:
+                    continue
+                finished.append((rid, reason))
+            admitted.extend(ev.admitted)
+        self._held = []
+        self._tick = t + 1
+        return StepEvents(emitted, finished, admitted, t)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def pop_result(self, request_id: int):
+        return self.replicas[request_id % len(self.replicas)] \
+            .pop_result(request_id)
+
+    def cancel(self, request_id: int) -> bool:
+        return self.replicas[request_id % len(self.replicas)] \
+            .cancel(request_id)
+
+    def recover(self) -> List[Request]:
+        """Reset ONLY the replicas whose last ``step()`` raised (all of
+        them when none is recorded — a defensive full sweep for callers
+        that hit an error outside step). Healthy replicas keep their
+        in-flight requests; their held events survive for the next clean
+        tick."""
+        targets = sorted(self._faulted) if self._faulted \
+            else range(len(self.replicas))
+        self._faulted.clear()
+        failed: List[Request] = []
+        for i in targets:
+            failed.extend(self.replicas[i].recover())
+        return failed
+
+    def drain(self, max_ticks: int = 100_000) -> None:
+        """Free-run every replica to idle CONCURRENTLY — each replica
+        ticks on its own thread at its own pace, no cross-replica barrier
+        (``step()``'s lockstep exists for the deterministic driver; a real
+        fleet's replicas never wait for each other). Per-replica results
+        stay poppable afterwards; per-tick StepEvents are not merged, so
+        this is for closed-load draining (benchmarks, batch jobs), not for
+        a streaming front-end."""
+        if len(self.replicas) == 1:
+            self.replicas[0].run_until_idle(max_ticks)
+            return
+        futures = [self._pool.submit(e.run_until_idle, max_ticks)
+                   for e in self.replicas]
+        errors = []
+        for i, f in enumerate(futures):
+            try:
+                f.result()
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                errors.append(exc)
+                self._faulted.add(i)
+        if errors:
+            raise errors[0]
+
+    def run_until_idle(self, max_ticks: int = 100_000) -> List[StepEvents]:
+        events = []
+        while not self.idle:
+            if len(events) >= max_ticks:
+                raise RuntimeError(f"fleet not idle after {max_ticks} ticks")
+            events.append(self.step())
+        return events
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+        for e in self.replicas:
+            e.close()

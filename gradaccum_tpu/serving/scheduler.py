@@ -73,8 +73,12 @@ class Scheduler:
         self.prefill_interval = prefill_interval
         self._queue: Deque[Request] = deque()
         # why admission stalled, per tick it stalled: "no_free_slots" vs
-        # "no_free_blocks" tells an operator which resource to grow
+        # "no_free_blocks" tells an operator which resource to grow. A
+        # replica engine sets ``label`` ("replica 2") so fleet-level stall
+        # keys also say WHICH engine is saturated; None keeps the
+        # single-engine keys exactly as they always were.
         self.stalls: Dict[str, int] = {}
+        self.label: Optional[str] = None
         # obs span tracer; an owning Engine built with an injected tracer
         # wires it in so stall events land on that engine's timeline —
         # otherwise the process-global tracer is resolved per use
@@ -91,6 +95,8 @@ class Scheduler:
         self._tracer = tracer
 
     def record_stall(self, reason: str) -> None:
+        if self.label is not None:
+            reason = f"{self.label}: {reason}"
         self.stalls[reason] = self.stalls.get(reason, 0) + 1
         tr = self.tracer
         if tr.enabled:

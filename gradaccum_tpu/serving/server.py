@@ -251,36 +251,64 @@ class ServingServer:
             if exc_type is None:
                 raise
 
+    @staticmethod
+    def _engine_stats(engine: Engine) -> Dict:
+        """One engine's live snapshot (caller holds the engine lock)."""
+        pool = engine.pool
+        out = {
+            "metrics": engine.metrics.summary(),
+            "queue_depth": engine.scheduler.depth,
+            "admission_stalls": dict(engine.scheduler.stalls),
+            "active_slots": pool.active_count,
+            "num_slots": pool.num_slots,
+        }
+        if engine.replica_id is not None:
+            out["replica_id"] = engine.replica_id
+        if engine.mesh is not None:
+            out["mesh"] = {n: int(engine.mesh.shape[n])
+                           for n in engine.mesh.axis_names}
+        if engine.paged:
+            out["free_kv_blocks"] = pool.free_blocks
+            out["num_kv_blocks"] = pool.num_blocks
+            out["kv_token_capacity"] = pool.token_capacity
+        if engine.prefix_cache is not None:
+            # live sharing state + the cumulative prefill bill the
+            # prefix cache saved — the operator's "is it earning its
+            # keep" view
+            out["prefix"] = {
+                "indexed_chunks": len(engine.prefix_cache),
+                "shared_kv_blocks": pool.shared_blocks,
+                "prefix_hit_rate": engine.metrics.prefix_hit_rate(),
+                "blocks_saved": engine.metrics.blocks_saved,
+                "prefill_tokens_skipped":
+                    engine.metrics.prefill_tokens_skipped,
+            }
+        return out
+
     def stats(self) -> Dict:
         """Thread-safe operator snapshot: the metrics summary plus live
         pool state — slot AND token/block occupancy (the paged pool's
-        admission currency) and why admission last stalled."""
+        admission currency) and why admission last stalled. Behind a
+        :class:`~gradaccum_tpu.serving.replicated.ReplicatedEngine` the
+        snapshot is the fleet aggregate plus a full ``per_replica``
+        breakdown (which replica is saturated is the first operator
+        question replicas introduce)."""
         with self._lock:
             engine = self._engine
-            pool = engine.pool
+            replicas = getattr(engine, "replicas", None)
+            if replicas is None:
+                return self._engine_stats(engine)
+            per = [self._engine_stats(e) for e in replicas]
             out = {
-                "metrics": engine.metrics.summary(),
-                "queue_depth": engine.scheduler.depth,
-                "admission_stalls": dict(engine.scheduler.stalls),
-                "active_slots": pool.active_count,
-                "num_slots": pool.num_slots,
+                "replicas": len(replicas),
+                "queue_depth": sum(p["queue_depth"] for p in per),
+                "active_slots": sum(p["active_slots"] for p in per),
+                "num_slots": sum(p["num_slots"] for p in per),
+                "per_replica": per,
             }
             if engine.paged:
-                out["free_kv_blocks"] = pool.free_blocks
-                out["num_kv_blocks"] = pool.num_blocks
-                out["kv_token_capacity"] = pool.token_capacity
-            if engine.prefix_cache is not None:
-                # live sharing state + the cumulative prefill bill the
-                # prefix cache saved — the operator's "is it earning its
-                # keep" view
-                out["prefix"] = {
-                    "indexed_chunks": len(engine.prefix_cache),
-                    "shared_kv_blocks": pool.shared_blocks,
-                    "prefix_hit_rate": engine.metrics.prefix_hit_rate(),
-                    "blocks_saved": engine.metrics.blocks_saved,
-                    "prefill_tokens_skipped":
-                        engine.metrics.prefill_tokens_skipped,
-                }
+                out["free_kv_blocks"] = sum(p["free_kv_blocks"] for p in per)
+                out["num_kv_blocks"] = sum(p["num_kv_blocks"] for p in per)
         return out
 
     def cancel(self, request_id: int) -> bool:
@@ -359,7 +387,8 @@ class ServingServer:
             # effort: the stall itself is already the story)
             try:
                 self._flight.dump("watchdog-stall",
-                                  extra={"elapsed_s": round(elapsed, 3)})
+                                  extra={"elapsed_s": round(elapsed, 3),
+                                         **self._engine.obs_tags()})
             except Exception:  # noqa: BLE001
                 pass
 
@@ -458,7 +487,8 @@ class ServingServer:
             try:
                 self._flight.dump("engine-fault-giveup" if give_up
                                   else "engine-fault",
-                                  extra={"error": repr(exc)})
+                                  extra={"error": repr(exc),
+                                         **self._engine.obs_tags()})
             except Exception:  # noqa: BLE001
                 pass
 

@@ -27,6 +27,28 @@ import numpy as np
 import pytest
 
 
+def pytest_runtest_setup(item):
+    """`multichip` gates need the simulated multi-device mesh. The env
+    block above forces it before jax imports (the "early-env fixture" —
+    XLA_FLAGS must precede backend init, so a regular fixture is too
+    late); this guard SKIPS, instead of cryptically failing, when someone
+    overrides XLA_FLAGS to a single host device."""
+    if item.get_closest_marker("multichip") and len(jax.devices()) < 2:
+        pytest.skip(
+            "multichip gates need >= 2 simulated devices "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
+
+
+@pytest.fixture
+def serving_mesh_2():
+    """A 2-chip `model`-axis serving mesh carved from the virtual CPU
+    devices — what the multichip parity gates shard the decode tick over."""
+    from gradaccum_tpu.parallel.mesh import serving_mesh
+
+    return serving_mesh(2)
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(19830610)  # the reference's seed (01:77 etc.)

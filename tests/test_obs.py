@@ -371,6 +371,52 @@ def test_registry_prometheus_export():
     assert "serving_ttft_count 1" in text
 
 
+def test_registry_cross_type_conflict_caught_despite_labels():
+    """The type-conflict guard compares metric FAMILIES: a labeled
+    instrument must not dodge it via its label-suffixed registry key and
+    silently coexist with another type of the same base name (the export
+    would merge both under one wrong TYPE line)."""
+    import pytest
+
+    from gradaccum_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.gauge("serving/depth").set(1)
+    with pytest.raises(ValueError, match="different type"):
+        reg.counter("serving/depth", labels={"replica": "0"})
+    reg.counter("serving/hits", labels={"replica": "0"}).inc()
+    with pytest.raises(ValueError, match="different type"):
+        reg.gauge("serving/hits")
+
+
+def test_registry_prometheus_families_are_contiguous():
+    """The exposition format requires one contiguous group per metric
+    family. A replica fleet registers the same base names interleaved
+    (replica 0's full instrument set, then replica 1's), so the export
+    must re-group by family or scrapers reject the payload."""
+    from gradaccum_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    for rep in ("0", "1"):  # interleaved, as ServingMetrics(replica_id=)
+        reg.counter("serving/tokens_total", labels={"replica": rep}).inc(1)
+        reg.gauge("serving/queue_depth", labels={"replica": rep}).set(2)
+        reg.histogram("serving/ttft", labels={"replica": rep}).observe(0.5)
+    current = None
+    seen = set()
+    for line in reg.to_prometheus().strip().split("\n"):
+        if line.startswith("# TYPE "):
+            current = line.split()[2]
+            assert current not in seen  # one TYPE line per family
+            seen.add(current)
+        else:
+            base = line.split("{")[0].split(" ")[0]
+            if base.endswith("_count"):
+                base = base[: -len("_count")]
+            assert base == current  # every sample sits under ITS type line
+    assert seen == {"serving_tokens_total", "serving_queue_depth",
+                    "serving_ttft"}
+
+
 def test_serving_metrics_absorbed_into_registry(tiny_lm):
     """ServingMetrics scalars/series are visible through one registry:
     per-tick gauges, lifetime counters, latency histograms, Prometheus."""

@@ -11,6 +11,11 @@ like BENCH_TPU.json) are listed for context but never gate; an
 UNREADABLE artifact gates as a failure — a truncated file must not
 silently retire the bar it used to carry.
 
+Artifacts with a ``scaling`` list (BENCH_serving_mp.json's replica curve)
+get a rendered 1→N column; ``MULTICHIP_r*.json`` dryrun artifacts are
+folded in too (ok / skipped / FAILED — a failed dryrun gates like a
+failed acceptance, a skipped one is listed but never gates).
+
 Usage: python tools/bench_trend.py [--dir DIR] [--json FILE]
 """
 
@@ -21,8 +26,29 @@ import os
 import sys
 
 
+def _scaling_column(data) -> str:
+    """Render a ``scaling`` list ([{replicas, tokens_per_s}, ...]) as the
+    1→N curve, ratios against the first point."""
+    scaling = data.get("scaling")
+    if not isinstance(scaling, list) or len(scaling) < 2:
+        return ""
+    try:
+        base = scaling[0]
+        parts = [
+            f"{base['replicas']}→{s['replicas']}: "
+            f"{s['tokens_per_s'] / base['tokens_per_s']:.2f}x"
+            for s in scaling[1:]
+        ]
+    except (KeyError, TypeError, ZeroDivisionError):
+        return ""
+    return "scaling " + ", ".join(parts)
+
+
 def collect(bench_dir: str):
-    """One record per BENCH_*.json: name, headline, acceptance (or None)."""
+    """One record per BENCH_*.json: name, headline, acceptance (or None).
+    MULTICHIP_r*.json dryrun artifacts ride along: ok -> PASS, skipped ->
+    listed without gating, anything else -> FAIL (a broken dryrun must
+    not keep reading as covered)."""
     rows = []
     for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
         name = os.path.basename(path)
@@ -45,9 +71,35 @@ def collect(bench_dir: str):
             # the one-line result an artifact chooses to lead with (e.g.
             # BENCH_obs.json's measured overhead ratios)
             "headline": data.get("headline"),
+            "scaling": _scaling_column(data) or None,
             "acceptance": acceptance,
             "passed": None if acceptance is None
             else bool(acceptance.get("passed")),
+        })
+    for path in sorted(glob.glob(os.path.join(bench_dir,
+                                              "MULTICHIP_r*.json"))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            rows.append({"file": name, "bench": f"<unreadable: {e}>",
+                         "headline": None, "scaling": None,
+                         "acceptance": {"required": "artifact must parse",
+                                        "passed": False},
+                         "passed": False})
+            continue
+        skipped = bool(data.get("skipped"))
+        ok = bool(data.get("ok"))
+        rows.append({
+            "file": name,
+            "bench": "multichip dryrun "
+                     + f"(n_devices={data.get('n_devices')})",
+            "headline": "skipped" if skipped else ("ok" if ok else "failed"),
+            "scaling": None,
+            "acceptance": None if skipped
+            else {"required": "dryrun ok", "passed": ok},
+            "passed": None if skipped else ok,
         })
     return rows
 
@@ -80,6 +132,8 @@ def main(argv=None) -> int:
             detail = f"{r['bench']}"
             if r["headline"]:
                 detail += f" — {r['headline']}"
+            if r.get("scaling"):
+                detail += f" — {r['scaling']}"
             if required != "":
                 detail += f" [{required}]"
             if not r["passed"]:

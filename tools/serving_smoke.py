@@ -12,10 +12,18 @@ followers must HIT the prefix index, skip their shared pages' prefill,
 and still match solo ``generate_cached`` token-for-token, with every
 block and index entry reclaimed at idle. Exit code 0 = PASS.
 
-Usage: python tools/serving_smoke.py [--paged] [--prefix]
+``--mesh dp,tp`` additionally exercises the multi-chip axes end-to-end on
+a simulated device mesh: ``tp`` runs one TP-SHARDED decode tick
+(``Engine(mesh=serving_mesh(2))``) and gates token parity + compile-once;
+``dp`` runs one REPLICATED dispatch (``ReplicatedEngine(replicas=2)``)
+and gates parity, globally-unique ids, per-replica compile bounds, and
+the manifest's mesh/replica record. Any comma combination works.
+
+Usage: python tools/serving_smoke.py [--paged] [--prefix] [--mesh dp,tp]
 """
 
 import argparse
+import os
 import sys
 from pathlib import Path
 
@@ -28,9 +36,23 @@ def main(argv=None):
                     help="run the smoke through the paged KV pool")
     ap.add_argument("--prefix", action="store_true",
                     help="paged pool + shared-prefix admission gates")
+    ap.add_argument("--mesh", default=None, metavar="AXES",
+                    help="multi-chip axes to smoke: 'tp', 'dp', or 'dp,tp'")
     args = ap.parse_args(argv)
     if args.prefix:
         args.paged = True
+    mesh_axes = []
+    if args.mesh is not None:
+        mesh_axes = [a.strip() for a in args.mesh.split(",") if a.strip()]
+        unknown = set(mesh_axes) - {"dp", "tp"}
+        if unknown:
+            ap.error(f"--mesh axes must be dp/tp, got {sorted(unknown)}")
+        # the mesh legs need simulated devices; must land before jax init
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
 
     import numpy as np
 
@@ -134,6 +156,71 @@ def main(argv=None):
         print(f"prefix: {eng.metrics.prefix_hits} hits, "
               f"{pm['prefill_tokens_skipped']} prefill tokens skipped, "
               f"blocks_saved={pm['blocks_saved']}")
+
+    # 6 (--mesh tp): one TP-sharded tick — parity + compile-once through
+    # a 2-chip model mesh (weights Megatron-sharded, pool BLOCK/head axis
+    # split), same jitted programs
+    if "tp" in mesh_axes:
+        from gradaccum_tpu.parallel.mesh import serving_mesh
+
+        if len(jax.devices()) < 2:
+            failures.append(f"--mesh tp needs >= 2 devices, "
+                            f"have {len(jax.devices())}")
+        else:
+            eng = Engine(params, cfg, num_slots=2, max_len=32,
+                         mesh=serving_mesh(2), **paged_kw)
+            p = rng.integers(0, cfg.vocab_size, 6).astype(np.int32)
+            rid = eng.submit(p, 5)
+            eng.run_until_idle()
+            want = np.asarray(generate_cached(params, cfg, p, 5))[0, 6:]
+            got, status = eng.pop_result(rid)
+            if status != "done" or not np.array_equal(np.asarray(got), want):
+                failures.append(f"tp parity mismatch: {got} vs {want}")
+            if eng.decode_compile_count() != 1:
+                failures.append(
+                    f"tp decode compiled {eng.decode_compile_count()}x"
+                )
+            if eng.manifest()["mesh"] != {"model": 2}:
+                failures.append(f"tp manifest mesh wrong: {eng.manifest()}")
+            print(f"mesh tp: 1 request sharded over {eng.manifest()['mesh']}"
+                  f", parity ok, decode programs=1")
+
+    # 7 (--mesh dp): one replicated dispatch — two engines, unique ids,
+    # parity, per-replica compile bounds, fleet manifest
+    if "dp" in mesh_axes:
+        from gradaccum_tpu.serving import ReplicatedEngine
+
+        fleet = ReplicatedEngine(params, cfg, replicas=2, tp=1,
+                                 num_slots=2, max_len=32, **paged_kw)
+        reqs = []
+        for i in range(4):
+            p = rng.integers(0, cfg.vocab_size, 4 + i).astype(np.int32)
+            reqs.append((fleet.submit(p, 5, rng_seed=i), p))
+        fleet.run_until_idle()
+        rids = [rid for rid, _ in reqs]
+        if len(set(rids)) != len(rids):
+            failures.append(f"dp request ids collide: {rids}")
+        if len({rid % 2 for rid in rids}) != 2:
+            failures.append(f"dp dispatch never spread replicas: {rids}")
+        for rid, p in reqs:
+            want = np.asarray(generate_cached(params, cfg, p, 5))[0, p.size:]
+            got, status = fleet.pop_result(rid)
+            if status != "done" or not np.array_equal(np.asarray(got), want):
+                failures.append(f"dp parity mismatch on request {rid}")
+        for eng in fleet.replicas:
+            if eng.decode_compile_count() > 1:
+                failures.append(
+                    f"replica {eng.replica_id} compiled "
+                    f"{eng.decode_compile_count()} decode programs"
+                )
+        fm = fleet.manifest()
+        if fm["replicas"] != 2 or len(fm["engines"]) != 2:
+            failures.append(f"fleet manifest wrong: {fm}")
+        if args.paged and any(e["page_size"] != 4 for e in fm["engines"]):
+            failures.append(f"fleet manifest paging knobs wrong: {fm}")
+        print(f"mesh dp: {len(reqs)} requests over 2 replicas "
+              f"(ids {rids}), parity ok")
+        fleet.close()
 
     if failures:
         print("FAIL:\n  " + "\n  ".join(failures))
