@@ -36,6 +36,17 @@ class RunConfig:
     # the same final checkpoint. None keeps the per-process flag (single
     # host / legacy behavior).
     drain_consensus: Optional[Any] = None
+    # obs/slo.py SLOEvaluator: when set, the train loop binds it to the
+    # run's metrics registry, ticks it on the STEP clock, and pushes the
+    # nonfinite-skip rate (guard-skipped micro-batches per host step, at
+    # each flush) as the "train/nonfinite_skip_rate" indicator — see
+    # obs.slo.default_training_objectives. Alerts land on the obs tracer.
+    slos: Optional[Any] = None
+    # obs/sentinel.py Sentinel: when set, the train loop feeds every
+    # dynamic-loss-scale sample into it (scale_storm detection); bind a
+    # drain remediation (resilience.remediation.request_drain) to turn a
+    # storm into an agreed cluster drain.
+    sentinel: Optional[Any] = None
 
 
 @dataclass

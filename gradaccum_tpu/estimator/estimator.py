@@ -631,6 +631,15 @@ class Estimator:
         drain_target = None
         micro_size = None
         last_saved = None
+        # live ops plane (obs/slo.py, obs/sentinel.py): the SLO evaluator
+        # runs on the STEP clock (deterministic — no wall time), pulling
+        # any registry-resolvable objectives each step and receiving the
+        # nonfinite-skip rate as a pushed indicator at flushes; the
+        # sentinel watches the loss-scale stream for halving storms
+        slos = self.config.slos
+        if slos is not None:
+            slos.bind_registry(self.registry)
+        sentinel = self.config.sentinel
 
         from gradaccum_tpu.obs import trace as obs_trace
         from gradaccum_tpu.utils.profiling import StepWindowProfiler
@@ -654,9 +663,16 @@ class Estimator:
                 )
                 loss_rows.clear()
             if skip_rows:
+                n_skip_rows = len(skip_rows)
                 flushed = int(sum(int(v) for v in jax.device_get(skip_rows)))
                 self.nonfinite_skips += flushed
                 skip_rows.clear()
+                if slos is not None and \
+                        "train/nonfinite_skip_rate" in slos.trackers:
+                    # skipped micro-batches per host step over this flush
+                    # window — the training-side burn-rate indicator
+                    slos.observe("train/nonfinite_skip_rate",
+                                 flushed / n_skip_rows, now=float(step_no))
                 if flushed and tracer.enabled:
                     # the guard verdict on the timeline: how many
                     # micro-batches this window zero-substituted
@@ -672,6 +688,11 @@ class Estimator:
                 rows = [(s, float(v)) for s, v in jax.device_get(scale_rows)]
                 scale_rows.clear()
                 self.loss_scale_series.extend(rows)
+                if sentinel is not None:
+                    # the scale-halving-storm detector rides the same
+                    # stream the series mirrors (step-clocked)
+                    for s, v in rows:
+                        sentinel.observe_scale(v, now=float(s))
                 if tracer.enabled:
                     for s, v in rows:
                         tracer.event("train/loss_scale", cat="train",
@@ -768,6 +789,10 @@ class Estimator:
                     )
                 step_no += k
                 faults.fire(faults.POST_TRAIN_STEP, step_no)
+                if slos is not None:
+                    # pull-based objectives sample on the step clock; the
+                    # host-side cost is a few dict lookups per objective
+                    slos.tick(now=float(step_no))
                 if "skipped" in aux:
                     skip_rows.append(aux["skipped"])
                     if len(skip_rows) >= 4096:  # same cap as loss_rows —

@@ -11,11 +11,25 @@ no-op under ``GRADACCUM_OBS=0``):
 - ``metrics`` — counters/gauges/histograms with JSON snapshots and
   Prometheus text export, bridging to the TensorBoard ``EventWriter``.
 - ``flight`` — a bounded ring of recent events dumped to
-  ``model_dir/flightrec/`` on crash, SIGTERM drain, or watchdog fire.
+  ``model_dir/flightrec/`` on crash, SIGTERM drain, or watchdog fire
+  (rotated at ``max_dumps`` so a crash loop cannot fill the disk).
+
+The LIVE ops plane stands on those three:
+
+- ``telemetry`` — embedded HTTP endpoints (``/metrics``, ``/healthz``,
+  ``/readyz``, ``/varz``, ``/trace``), off by default, zero deps;
+- ``slo`` — sliding-window objectives evaluated as multi-window
+  burn-rate alerts, deterministic under the simulation clock;
+- ``sentinel`` — rolling-baseline anomaly detection (latency cliffs,
+  heartbeat leases, loss-scale storms) wired to pluggable remediation
+  (``resilience/remediation.py`` binds the recover/requeue/drain
+  contract).
 
 Render a run summary from traces/dumps with ``tools/obs_report.py``;
+replay SLO specs against recorded traces with ``tools/slo_check.py``;
 enabled-vs-disabled overhead is measured by ``tools/bench_obs.py``
-(BENCH_obs.json).
+(BENCH_obs.json) and the ops plane's serve-path cost by
+``tools/bench_slo.py`` (BENCH_slo.json).
 """
 
 from gradaccum_tpu.obs.flight import FlightRecorder
@@ -25,6 +39,14 @@ from gradaccum_tpu.obs.metrics import (
     Histogram,
     MetricsRegistry,
 )
+from gradaccum_tpu.obs.sentinel import Anomaly, Sentinel
+from gradaccum_tpu.obs.slo import (
+    Objective,
+    SLOEvaluator,
+    default_serving_objectives,
+    default_training_objectives,
+)
+from gradaccum_tpu.obs.telemetry import TelemetryServer
 from gradaccum_tpu.obs.trace import (
     NULL,
     NullTracer,
@@ -36,6 +58,7 @@ from gradaccum_tpu.obs.trace import (
 )
 
 __all__ = [
+    "Anomaly",
     "Counter",
     "FlightRecorder",
     "Gauge",
@@ -43,7 +66,13 @@ __all__ = [
     "MetricsRegistry",
     "NULL",
     "NullTracer",
+    "Objective",
+    "SLOEvaluator",
+    "Sentinel",
+    "TelemetryServer",
     "Tracer",
+    "default_serving_objectives",
+    "default_training_objectives",
     "get_tracer",
     "installed",
     "obs_enabled",

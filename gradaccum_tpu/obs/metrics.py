@@ -40,12 +40,26 @@ def _prom_name(name: str) -> str:
     return n
 
 
+def _escape_label(value: str) -> str:
+    """Label-value escaping per the exposition format: backslash, double
+    quote and newline must be escaped or a replica label carrying an odd
+    string (a mesh spec, an error message) breaks the whole scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping: backslash and newline only (quotes are legal)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _label_str(labels: Optional[Dict[str, str]]) -> str:
     """Prometheus-style rendering, '' when unlabeled. Sorted so the same
     label set always produces the same instrument key."""
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
@@ -121,13 +135,22 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        # family base name -> HELP text (first non-None registration wins;
+        # families without one export their name as the help line)
+        self._help: Dict[str, str] = {}
 
     # -- instrument accessors (memoized; type conflicts are bugs) ---------
 
+    def _note_help(self, name: str, help: Optional[str]) -> None:
+        if help is not None and name not in self._help:
+            self._help[name] = str(help)
+
     def counter(self, name: str,
-                labels: Optional[Dict[str, str]] = None) -> Counter:
+                labels: Optional[Dict[str, str]] = None,
+                help: Optional[str] = None) -> Counter:
         key = _full_name(name, labels)
         with self._lock:
+            self._note_help(name, help)
             c = self._counters.get(key)
             if c is None:
                 self._check_free(name, self._counters)
@@ -135,9 +158,11 @@ class MetricsRegistry:
             return c
 
     def gauge(self, name: str,
-              labels: Optional[Dict[str, str]] = None) -> Gauge:
+              labels: Optional[Dict[str, str]] = None,
+              help: Optional[str] = None) -> Gauge:
         key = _full_name(name, labels)
         with self._lock:
+            self._note_help(name, help)
             g = self._gauges.get(key)
             if g is None:
                 self._check_free(name, self._gauges)
@@ -146,9 +171,11 @@ class MetricsRegistry:
 
     def histogram(self, name: str,
                   series: Optional[LatencySeries] = None,
-                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+                  labels: Optional[Dict[str, str]] = None,
+                  help: Optional[str] = None) -> Histogram:
         key = _full_name(name, labels)
         with self._lock:
+            self._note_help(name, help)
             h = self._histograms.get(key)
             if h is None:
                 self._check_free(name, self._histograms)
@@ -159,6 +186,27 @@ class MetricsRegistry:
                 # track the instance that is actually recording
                 h.series = series
             return h
+
+    def find(self, name: str):
+        """The first instrument of family ``name`` as ``(kind, instrument)``
+        — kind one of "counter"/"gauge"/"histogram" — or ``(None, None)``."""
+        kind, insts = self.find_all(name)
+        return (kind, insts[0]) if insts else (None, None)
+
+    def find_all(self, name: str):
+        """EVERY instrument of family ``name`` as ``(kind, [instruments])``
+        — or ``(None, [])``. The SLO evaluator's pull hook: a fleet
+        registers one labeled instrument per replica under the same family
+        name, and an objective on that family must see the whole fleet,
+        not whichever replica registered first."""
+        with self._lock:
+            for kind, store in (("counter", self._counters),
+                                ("gauge", self._gauges),
+                                ("histogram", self._histograms)):
+                insts = [i for i in store.values() if i.name == name]
+                if insts:
+                    return kind, insts
+        return None, []
 
     def _check_free(self, name: str, own: dict) -> None:
         # a conflict is the same FAMILY (base name) under another type —
@@ -219,31 +267,38 @@ class MetricsRegistry:
 
     def to_prometheus(self) -> str:
         """Prometheus text exposition. Histograms export summary-style
-        quantiles (p50/p90/p99) plus ``_count``."""
+        quantiles (p50/p90/p99) plus ``_count``. Every family gets a
+        ``# HELP`` line (the registered help text, or the instrument name)
+        ahead of its ``# TYPE`` line, and label values are escaped per the
+        exposition format, so the payload stays promtool-valid even with
+        odd replica/mesh label strings."""
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             hists = dict(self._histograms)
+            help_texts = dict(self._help)
         # the exposition format requires every sample of a metric family
         # to form ONE contiguous group under its TYPE line — a fleet's
         # replicas register the same base names interleaved, so bucket by
         # family (first-registration order) before rendering
         families: Dict[str, tuple] = {}
 
-        def bucket(pn: str, kind: str, rows) -> None:
+        def bucket(pn: str, name: str, kind: str, rows) -> None:
             fam = families.get(pn)
             if fam is None:
-                fam = families[pn] = (kind, [])
-            fam[1].extend(rows)
+                fam = families[pn] = (name, kind, [])
+            fam[2].extend(rows)
 
         for c in counters.values():
             pn = _prom_name(c.name)
-            bucket(pn, "counter", [f"{pn}{_label_str(c.labels)} {c.value}"])
+            bucket(pn, c.name, "counter",
+                   [f"{pn}{_label_str(c.labels)} {c.value}"])
         for g in gauges.values():
             if g.value is None:
                 continue
             pn = _prom_name(g.name)
-            bucket(pn, "gauge", [f"{pn}{_label_str(g.labels)} {g.value}"])
+            bucket(pn, g.name, "gauge",
+                   [f"{pn}{_label_str(g.labels)} {g.value}"])
         for h in hists.values():
             pn = _prom_name(h.name)
             s = h.summary()
@@ -253,9 +308,12 @@ class MetricsRegistry:
                     qlabels = dict(h.labels or {}, quantile=q)
                     rows.append(f"{pn}{_label_str(qlabels)} {s[key]}")
             rows.append(f"{pn}_count{_label_str(h.labels)} {s['count']}")
-            bucket(pn, "summary", rows)
+            bucket(pn, h.name, "summary", rows)
         lines = []
-        for pn, (kind, rows) in families.items():
+        for pn, (name, kind, rows) in families.items():
+            lines.append(
+                f"# HELP {pn} {_escape_help(help_texts.get(name, name))}"
+            )
             lines.append(f"# TYPE {pn} {kind}")
             lines.extend(rows)
         return "\n".join(lines) + ("\n" if lines else "")

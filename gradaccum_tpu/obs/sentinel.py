@@ -1,0 +1,410 @@
+"""Anomaly sentinel: rolling baselines, heartbeat leases, remediation.
+
+The SLO evaluator (``obs/slo.py``) answers "are we meeting explicit
+objectives"; the sentinel answers the complementary question — *does the
+system look like itself?* — and closes the loop by invoking remediation.
+It keeps per-replica rolling baselines (EWMA mean + EWMA absolute
+deviation, a streaming stand-in for median/MAD that needs O(1) state) and
+a tick-heartbeat lease per replica, and detects four anomaly classes:
+
+- ``latency_cliff`` — a tick duration many deviations above its replica's
+  baseline, sustained for ``cliff_consecutive`` ticks (one GC pause or
+  scheduler burp never fires it);
+- ``stall`` / ``dead_replica`` — the heartbeat lease expired while the
+  engine (or one replica of a fleet) last reported itself busy: the
+  ROADMAP's "distinguish slow from gone" precursor;
+- ``scale_storm`` — the dynamic loss scale halved ``storm_halvings``
+  times inside one window (a run drowning in overflow, not riding one);
+- ``engine_fault`` — edge-triggered note from the serving fault handler,
+  so faults land in the same anomaly log operators read.
+
+Every NEW anomaly lands as a ``sentinel/anomaly`` span event, a flight
+recorder dump (``sentinel-<kind>``), and a registry counter bump, then
+runs the remediation callbacks registered for its kind — which are bound
+to the EXISTING recovery contract (``ServingServer.request_recover`` →
+recover + bounded requeue, ``DrainConsensus.request`` → agreed drain; see
+``resilience/remediation.py``). Anomalies are level-held: a kind/replica
+pair fires once and must resolve (heartbeat resumes, latency returns to
+baseline) before it can fire again.
+
+Determinism: like the tracer and the SLO evaluator, the clock is
+injectable and anomaly records carry only sample-derived fields, so a
+seeded simulation produces a byte-identical anomaly log.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from gradaccum_tpu.obs import trace as obs_trace
+
+STALL = "stall"
+DEAD_REPLICA = "dead_replica"
+LATENCY_CLIFF = "latency_cliff"
+SCALE_STORM = "scale_storm"
+ENGINE_FAULT = "engine_fault"
+
+KINDS = (STALL, DEAD_REPLICA, LATENCY_CLIFF, SCALE_STORM, ENGINE_FAULT)
+
+
+class RollingBaseline:
+    """EWMA mean + EWMA absolute deviation — a robust-ish streaming
+    baseline in two floats. ``score(x)`` is the deviation multiple of
+    ``x`` over the mean (deviation units, not strict sigmas)."""
+
+    __slots__ = ("alpha", "mean", "dev", "n")
+
+    def __init__(self, alpha: float = 0.25):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = alpha
+        self.mean: Optional[float] = None
+        self.dev = 0.0
+        self.n = 0
+
+    def update(self, x: float) -> None:
+        x = float(x)
+        if self.mean is None:
+            self.mean = x
+        else:
+            # deviation against the PRE-update mean, so a level shift
+            # registers as deviation before the mean chases it
+            a = self.alpha
+            self.dev = (1 - a) * self.dev + a * abs(x - self.mean)
+            self.mean = (1 - a) * self.mean + a * x
+        self.n += 1
+
+    def score(self, x: float) -> float:
+        if self.mean is None:
+            return 0.0
+        # the floor keeps a near-zero-variance baseline (idle ticks all
+        # identical) from turning the first normal wobble into infinity
+        denom = max(self.dev, abs(self.mean) * 1e-3, 1e-9)
+        return (float(x) - self.mean) / denom
+
+
+@dataclasses.dataclass
+class Anomaly:
+    """One anomaly-log record (fire or resolve transition)."""
+
+    kind: str
+    state: str  # "fire" | "resolve"
+    at: float
+    replica: Optional[int] = None
+    detail: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "state": self.state, "at": self.at,
+                "replica": self.replica, "detail": dict(self.detail)}
+
+
+class Sentinel:
+    """Baseline keeper, lease checker, and remediation dispatcher.
+
+    Feeding (all host-side, all cheap):
+
+    - ``heartbeat(replica=, tick=, busy=)`` once per clean tick;
+    - ``observe_tick(duration, replica=)`` with the tick's wall cost;
+    - ``observe_scale(scale)`` with each loss-scale sample;
+    - ``note_fault(...)`` from a fault handler (edge-triggered record —
+      remediation is NOT run for it; the caller's own recovery already
+      is the remediation).
+
+    ``check(now=)`` evaluates the leases; the serving loop calls it each
+    iteration, and ``start()`` runs it on a background thread every
+    ``check_interval`` seconds as the backstop for a loop that stopped
+    iterating (a wedged tick also trips the server's watchdog).
+
+    Thread-safety: one lock around all mutable state — feeders (engine
+    loop, replica pool threads) and the checker thread may interleave.
+    """
+
+    def __init__(
+        self,
+        clock: Optional[Callable[[], float]] = None,
+        tracer=None,
+        flight=None,
+        registry=None,
+        lease: float = 5.0,
+        cliff_score: float = 8.0,
+        cliff_warmup: int = 8,
+        cliff_consecutive: int = 2,
+        storm_halvings: int = 3,
+        storm_window: float = 64.0,
+        check_interval: Optional[float] = None,
+    ):
+        if clock is None:
+            t0 = time.monotonic()
+            clock = lambda: time.monotonic() - t0
+        self.clock = clock
+        self._tracer = tracer
+        self.flight = flight
+        self.registry = registry
+        self.lease = float(lease)
+        self.cliff_score = float(cliff_score)
+        self.cliff_warmup = int(cliff_warmup)
+        self.cliff_consecutive = int(cliff_consecutive)
+        self.storm_halvings = int(storm_halvings)
+        self.storm_window = float(storm_window)
+        self.check_interval = check_interval
+        self._lock = threading.Lock()
+        # replica key (None = the single engine) -> lease state
+        self._hb: Dict[Optional[int], Tuple[float, Optional[int], bool]] = {}
+        self._tick_base: Dict[Optional[int], RollingBaseline] = {}
+        self._cliff_run: Dict[Optional[int], int] = {}
+        self._scales: deque = deque()  # (t, scale)
+        self._remedies: Dict[str, List[Callable[[Anomaly], None]]] = {}
+        self._firing: Dict[Tuple[str, Optional[int]], Anomaly] = {}
+        self.anomalies: List[Anomaly] = []  # the log (fire + resolve)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @property
+    def tracer(self):
+        return obs_trace.resolve(self._tracer)
+
+    # -- remediation registry ---------------------------------------------
+
+    def on(self, kind: str, callback: Callable[[Anomaly], None]) -> "Sentinel":
+        """Register ``callback(anomaly)`` for ``kind`` (or ``"*"`` for
+        every kind). Callbacks run inline on the detecting thread; an
+        exception is recorded on the tracer and swallowed — a broken
+        remediation must not kill the detector."""
+        if kind != "*" and kind not in KINDS:
+            raise ValueError(f"unknown anomaly kind {kind!r} (not in {KINDS})")
+        self._remedies.setdefault(kind, []).append(callback)
+        return self
+
+    # -- transitions -------------------------------------------------------
+
+    def _fire(self, kind: str, replica: Optional[int], detail: dict,
+              now: float, remediate: bool = True) -> Optional[Anomaly]:
+        key = (kind, replica)
+        with self._lock:
+            if key in self._firing:
+                return None  # level-held: already firing
+            anomaly = Anomaly(kind, "fire", float(now), replica, detail)
+            self._firing[key] = anomaly
+            self.anomalies.append(anomaly)
+            remedies = (self._remedies.get(kind, [])
+                        + self._remedies.get("*", []))
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("sentinel/anomaly", cat="sentinel", kind=kind,
+                     state="fire", replica=replica, **detail)
+        if self.registry is not None:
+            self.registry.counter(
+                "sentinel/anomalies_total", labels={"kind": kind},
+                help="sentinel anomaly firings",
+            ).inc()
+        if self.flight is not None:
+            try:  # the anomaly is the story; a failed postmortem is not
+                self.flight.dump(f"sentinel-{kind}",
+                                 extra=anomaly.to_dict())
+            except Exception:  # noqa: BLE001
+                pass
+        if remediate:
+            for cb in remedies:
+                name = getattr(cb, "__name__", repr(cb))
+                try:
+                    cb(anomaly)
+                    if tr.enabled:
+                        tr.event("sentinel/remediation", cat="sentinel",
+                                 kind=kind, replica=replica, action=name)
+                except Exception as e:  # noqa: BLE001
+                    if tr.enabled:
+                        tr.event("sentinel/remediation", cat="sentinel",
+                                 kind=kind, replica=replica, action=name,
+                                 error=type(e).__name__)
+        return anomaly
+
+    def _resolve(self, kind: str, replica: Optional[int], now: float,
+                 detail: Optional[dict] = None) -> None:
+        key = (kind, replica)
+        with self._lock:
+            if key not in self._firing:
+                return
+            del self._firing[key]
+            self.anomalies.append(
+                Anomaly(kind, "resolve", float(now), replica, detail or {})
+            )
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("sentinel/anomaly", cat="sentinel", kind=kind,
+                     state="resolve", replica=replica, **(detail or {}))
+
+    # -- feeders -----------------------------------------------------------
+
+    def heartbeat(self, replica: Optional[int] = None,
+                  tick: Optional[int] = None, busy: bool = True,
+                  now: Optional[float] = None) -> None:
+        """One clean tick happened on ``replica`` (None = the single
+        engine). ``busy=False`` parks the lease (an idle engine is not
+        stalled). A resumed heartbeat auto-resolves that replica's
+        stall/dead anomaly."""
+        t = self.clock() if now is None else float(now)
+        with self._lock:
+            self._hb[replica] = (t, tick, bool(busy))
+        kind = STALL if replica is None else DEAD_REPLICA
+        self._resolve(kind, replica, t, {"tick": tick})
+
+    def observe_tick(self, duration: float, replica: Optional[int] = None,
+                     now: Optional[float] = None) -> None:
+        """Feed one tick's duration into the replica's rolling baseline;
+        fires ``latency_cliff`` after ``cliff_consecutive`` warmed samples
+        beyond ``cliff_score`` deviations."""
+        t = self.clock() if now is None else float(now)
+        with self._lock:
+            base = self._tick_base.get(replica)
+            if base is None:
+                base = self._tick_base[replica] = RollingBaseline()
+            warmed = base.n >= self.cliff_warmup
+            score = base.score(duration) if warmed else 0.0
+            cliff = warmed and score >= self.cliff_score
+            if cliff:
+                run = self._cliff_run.get(replica, 0) + 1
+                self._cliff_run[replica] = run
+                baseline = base.mean
+                # a cliff sample must not feed the baseline: two slow ticks
+                # would drag the EWMA up and mask the third
+            else:
+                run = self._cliff_run[replica] = 0
+                base.update(duration)
+        if cliff and run >= self.cliff_consecutive:
+            self._fire(LATENCY_CLIFF, replica, {
+                "duration": float(duration),
+                "baseline": round(float(baseline), 9),
+                "score": round(float(score), 3),
+                "consecutive": run,
+            }, t)
+        elif not cliff:
+            self._resolve(LATENCY_CLIFF, replica, t)
+
+    def observe_scale(self, scale: float, now: Optional[float] = None) -> None:
+        """Feed one dynamic-loss-scale sample; ``storm_halvings`` drops
+        within ``storm_window`` clock units fire ``scale_storm``."""
+        t = self.clock() if now is None else float(now)
+        with self._lock:
+            self._scales.append((t, float(scale)))
+            cutoff = t - self.storm_window
+            while self._scales and self._scales[0][0] <= cutoff:
+                self._scales.popleft()
+            halvings = sum(
+                1 for i in range(1, len(self._scales))
+                if self._scales[i][1] < self._scales[i - 1][1]
+            )
+        if halvings >= self.storm_halvings:
+            self._fire(SCALE_STORM, None,
+                       {"halvings": halvings, "scale": float(scale)}, t)
+        else:
+            self._resolve(SCALE_STORM, None, t)
+
+    def note_fault(self, error: str = "", replica: Optional[int] = None,
+                   now: Optional[float] = None) -> None:
+        """Edge-triggered fault record from a fault handler. Remediation
+        is deliberately NOT run — the caller (the server's recover/requeue
+        path) IS the remediation; this puts the fault in the anomaly log
+        and immediately clears the level so the next fault records too."""
+        t = self.clock() if now is None else float(now)
+        self._fire(ENGINE_FAULT, replica, {"error": error}, t,
+                   remediate=False)
+        self._resolve(ENGINE_FAULT, replica, t)
+
+    # -- the lease check ---------------------------------------------------
+
+    def check(self, now: Optional[float] = None) -> List[Anomaly]:
+        """Evaluate heartbeat leases; returns anomalies fired by THIS
+        call. A replica whose last heartbeat said ``busy`` and is older
+        than ``lease`` is stalled (single engine) or dead (fleet)."""
+        t = self.clock() if now is None else float(now)
+        with self._lock:
+            expired = [
+                (replica, hb_t, tick)
+                for replica, (hb_t, tick, busy) in self._hb.items()
+                if busy and t - hb_t > self.lease
+            ]
+        fired = []
+        for replica, hb_t, tick in expired:
+            kind = STALL if replica is None else DEAD_REPLICA
+            a = self._fire(kind, replica, {
+                "last_heartbeat": float(hb_t), "last_tick": tick,
+                "lease": self.lease,
+            }, t)
+            if a is not None:
+                fired.append(a)
+        return fired
+
+    # -- background checker ------------------------------------------------
+
+    def start(self) -> "Sentinel":
+        """Run ``check`` every ``check_interval`` seconds on a daemon
+        thread — the backstop for a serving loop wedged inside a tick
+        (which cannot reach its own per-iteration check)."""
+        if self.check_interval is None:
+            raise ValueError("start() needs check_interval")
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.check_interval):
+                try:
+                    self.check()
+                except Exception:  # noqa: BLE001 — the checker must survive
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="obs-sentinel")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+
+    # -- export ------------------------------------------------------------
+
+    def firing(self) -> List[Tuple[str, Optional[int]]]:
+        with self._lock:
+            return sorted(self._firing, key=lambda k: (k[0], k[1] is not None,
+                                                       k[1] or 0))
+
+    def status(self) -> dict:
+        """Live view for the telemetry plane / operator tooling."""
+        with self._lock:
+            hb = {
+                ("engine" if r is None else f"replica {r}"): {
+                    "at": t, "tick": tick, "busy": busy,
+                }
+                for r, (t, tick, busy) in self._hb.items()
+            }
+            baselines = {
+                ("engine" if r is None else f"replica {r}"): {
+                    "mean": None if b.mean is None else round(b.mean, 9),
+                    "dev": round(b.dev, 9), "samples": b.n,
+                }
+                for r, b in self._tick_base.items()
+            }
+            n_anomalies = len(self.anomalies)
+        return {
+            "firing": [{"kind": k, "replica": r} for k, r in self.firing()],
+            "heartbeats": hb,
+            "tick_baselines": baselines,
+            "anomalies": n_anomalies,
+        }
+
+    def anomalies_bytes(self) -> bytes:
+        """Canonical serialization of the anomaly log (fires + resolves)
+        — byte-identical across seeded runs under a deterministic clock."""
+        with self._lock:
+            records = [a.to_dict() for a in self.anomalies]
+        return (json.dumps(records, sort_keys=True,
+                           separators=(",", ":")) + "\n").encode()

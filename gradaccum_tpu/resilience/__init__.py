@@ -15,6 +15,9 @@ supplies both halves of that proof:
 - :mod:`watchdog` — a stall detector for the serving engine's tick loop.
 - :mod:`preemption` — SIGTERM handling so a preempted trainer drains its
   async checkpoint writer and lands one final checkpoint.
+- :mod:`remediation` — the obs sentinel's anomaly kinds bound to THIS
+  package's recovery contract (server recover + requeue, drain
+  consensus), so detection closes the loop through proven machinery.
 
 The consumers live in :mod:`gradaccum_tpu.estimator` (non-finite-gradient
 skip, checkpoint integrity, graceful shutdown) and
@@ -24,7 +27,13 @@ seeded step inside an accumulation window and asserts the resumed
 loss/param trajectory is bitwise identical to the uninterrupted run.
 """
 
-from gradaccum_tpu.resilience import faults, manifest, preemption, retry
+from gradaccum_tpu.resilience import (
+    faults,
+    manifest,
+    preemption,
+    remediation,
+    retry,
+)
 from gradaccum_tpu.resilience.faults import (
     FaultInjector,
     FaultSchedule,
@@ -44,6 +53,7 @@ __all__ = [
     "faults",
     "manifest",
     "preemption",
+    "remediation",
     "retry",
     "DrainConsensus",
     "FaultInjector",

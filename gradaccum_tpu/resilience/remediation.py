@@ -1,0 +1,71 @@
+"""Standard remediation bindings: sentinel anomaly → existing contract.
+
+The obs sentinel (``obs/sentinel.py``) detects; this module decides what
+detection DOES, by binding anomaly kinds to the recovery machinery that
+already exists and is already gated in tier-1 — never a new side channel:
+
+- :func:`recover_and_requeue` routes through
+  :meth:`~gradaccum_tpu.serving.server.ServingServer.request_recover`,
+  i.e. the PR-2 engine-fault path (``Engine.recover`` → bounded requeue →
+  flight dump) executed on the loop thread where the engine lock is safe;
+- :func:`request_drain` marks this host preempted on a
+  :class:`~gradaccum_tpu.resilience.preemption.DrainConsensus`, so the
+  next ``decide()`` round agrees a cluster-wide drain to a common step —
+  the same path a SIGTERM takes.
+
+:func:`bind_default_remediations` wires the stock matrix (also the README
+"Operations" table): latency cliffs / stalls / dead replicas recover and
+requeue; a loss-scale storm drains the training job.
+"""
+
+from __future__ import annotations
+
+from gradaccum_tpu.obs import sentinel as obs_sentinel
+
+
+def recover_and_requeue(server):
+    """Remediation callback: ask ``server`` (a :class:`ServingServer`) to
+    run its engine-fault recovery at the next loop iteration."""
+
+    def remedy(anomaly):
+        who = "" if anomaly.replica is None else f" replica {anomaly.replica}"
+        server.request_recover(f"sentinel:{anomaly.kind}{who}")
+
+    remedy.__name__ = "recover_and_requeue"
+    return remedy
+
+
+def request_drain(consensus):
+    """Remediation callback: mark this host preempted on ``consensus`` (a
+    :class:`DrainConsensus`) — the next decide() round agrees the drain
+    exactly as if SIGTERM had arrived here."""
+
+    def remedy(anomaly):
+        consensus.request()
+
+    remedy.__name__ = "request_drain"
+    return remedy
+
+
+def bind_default_remediations(sentinel, server=None, consensus=None):
+    """The stock remediation matrix. Only the bindings whose target is
+    provided are installed; returns ``sentinel`` for chaining.
+
+    ========================= =====================================
+    anomaly                   remediation
+    ========================= =====================================
+    ``latency_cliff``         ``server`` recover + bounded requeue
+    ``stall``                 ``server`` recover + bounded requeue
+    ``dead_replica``          ``server`` recover + bounded requeue
+    ``scale_storm``           ``consensus`` drain request
+    ``engine_fault``          (none — the fault handler already ran)
+    ========================= =====================================
+    """
+    if server is not None:
+        remedy = recover_and_requeue(server)
+        for kind in (obs_sentinel.LATENCY_CLIFF, obs_sentinel.STALL,
+                     obs_sentinel.DEAD_REPLICA):
+            sentinel.on(kind, remedy)
+    if consensus is not None:
+        sentinel.on(obs_sentinel.SCALE_STORM, request_drain(consensus))
+    return sentinel

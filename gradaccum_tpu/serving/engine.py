@@ -972,6 +972,7 @@ class Engine:
         enabled = tr.enabled
         now = tr.now() if enabled else 0.0
         for r in reqs:
+            self.metrics.record_admit(r.request_id)
             # the queue span closes here (submit -> admission) and the
             # service span opens — both keyed by rid on one timeline;
             # submit entries pop even when tracing was disabled mid-queue

@@ -35,8 +35,14 @@ class ServingMetrics:
         clock: Callable[[], float] = time.monotonic,
         registry: Optional[MetricsRegistry] = None,
         replica_id: Optional[int] = None,
+        latency_window: Optional[int] = None,
     ):
         self.clock = clock
+        # latency_window bounds the latency series (ttft / token gap /
+        # queue wait) to the most recent N samples, so SLO evaluation
+        # reads a CURRENT p99 instead of cumulative-since-boot; None (the
+        # default) keeps every sample exactly as before
+        self.latency_window = latency_window
         # replica_id puts a REPLICA DIMENSION on the existing instruments
         # (same gauge/counter names, labeled {replica="N"}) instead of
         # minting per-replica scalar names — so a ReplicatedEngine fleet
@@ -47,8 +53,9 @@ class ServingMetrics:
                         else {"replica": str(self.replica_id)})
         self.registry = registry if registry is not None else \
             MetricsRegistry(event_writer=event_writer, subdir=subdir)
-        self.ttft = LatencySeries()          # submit -> first token
-        self.token_latency = LatencySeries()  # inter-token gap, per request
+        self.ttft = LatencySeries(window=latency_window)  # submit -> 1st tok
+        self.token_latency = LatencySeries(window=latency_window)  # gap/req
+        self.queue_wait = LatencySeries(window=latency_window)  # submit->admit
         self.queue_depth = LatencySeries()    # sampled per tick
         self.occupancy = LatencySeries()      # sampled per tick (slots)
         # token-level view, present for BOTH pool kinds so fixed and paged
@@ -84,6 +91,7 @@ class ServingMetrics:
         for name, series in (
             ("serving/ttft", self.ttft),
             ("serving/token_latency", self.token_latency),
+            ("serving/queue_wait", self.queue_wait),
             ("serving/queue_depth_series", self.queue_depth),
             ("serving/occupancy_series", self.occupancy),
         ):
@@ -108,6 +116,13 @@ class ServingMetrics:
     def record_reject(self, request_id: int) -> None:
         self.rejected += 1
         self._c_rejected.inc()
+
+    def record_admit(self, request_id: int) -> None:
+        """The request left the queue for a slot: its queue wait (submit →
+        admission, in clock units) lands in the windowed series the
+        queue-wait SLO reads."""
+        if request_id in self._submit_t:
+            self.queue_wait.add(self.clock() - self._submit_t[request_id])
 
     def record_token(self, request_id: int, first: bool) -> None:
         now = self.clock()
@@ -220,6 +235,7 @@ class ServingMetrics:
             "replica_id": self.replica_id,
             "ttft": self.ttft.summary(),
             "token_latency": self.token_latency.summary(),
+            "queue_wait": self.queue_wait.summary(),
             "queue_depth": self.queue_depth.summary(),
             "occupancy": self.occupancy.summary(),
             "token_occupancy": self.token_occupancy.summary(),

@@ -185,6 +185,8 @@ class ReplicatedEngine:
         devices=None,
         scheduler_factory=None,
         tracer=None,
+        sentinel=None,
+        latency_window: Optional[int] = None,
         **engine_kwargs,
     ):
         if replicas < 1:
@@ -200,6 +202,12 @@ class ReplicatedEngine:
         devices = list(jax.devices()) if devices is None else list(devices)
         self.cfg = cfg
         self._tracer = tracer
+        # an attached obs sentinel gets one heartbeat PER REPLICA per
+        # clean replica tick (from step()) — a replica whose ticks keep
+        # faulting stops heartbeating and its lease expires into a
+        # dead_replica anomaly, which is how the fleet distinguishes
+        # "slow" from "gone" without waiting on a barrier timeout
+        self.sentinel = sentinel
         self.registry = MetricsRegistry(subdir="serving")
         self.metrics = _FleetMetrics(self)
         self.replicas: List[Engine] = []
@@ -223,7 +231,8 @@ class ReplicatedEngine:
             self.replicas.append(Engine(
                 params, cfg, mesh=mesh, replica_id=i,
                 id_start=i, id_stride=replicas, scheduler=sched,
-                metrics=ServingMetrics(registry=self.registry, replica_id=i),
+                metrics=ServingMetrics(registry=self.registry, replica_id=i,
+                                       latency_window=latency_window),
                 tracer=tracer, **engine_kwargs,
             ))
         self.results = _FleetDict(self.replicas, "results")
@@ -347,8 +356,12 @@ class ReplicatedEngine:
         filtered to requests whose results the fault handler has not
         already reconciled away."""
         t = self._tick
+        snt = self.sentinel
         if self._pool is None:
             evs = [self.replicas[0].step()]
+            if snt is not None:
+                snt.heartbeat(replica=0, tick=self.replicas[0].tick_count,
+                              busy=not self.replicas[0].idle)
         else:
             tr = self.tracer
             if tr.enabled and getattr(tr, "deterministic", False):
@@ -363,6 +376,13 @@ class ReplicatedEngine:
             for i, w in enumerate(waits):
                 try:
                     evs.append(w())
+                    if snt is not None:
+                        # only a CLEAN replica tick renews the lease — a
+                        # replica stuck faulting goes quiet and expires
+                        # into a dead_replica anomaly
+                        snt.heartbeat(replica=i,
+                                      tick=self.replicas[i].tick_count,
+                                      busy=not self.replicas[i].idle)
                 except Exception as exc:  # noqa: BLE001 — re-raised below
                     errors.append(exc)
                     self._faulted.add(i)

@@ -143,6 +143,13 @@ class StreamHandle:
         return self._error
 
 
+class SentinelRemediation(RuntimeError):
+    """The exception a sentinel-requested recover is routed through: it
+    enters :meth:`ServingServer._handle_engine_fault` exactly like an
+    engine fault would, so remediation reuses the PROVEN recover → bounded
+    requeue machinery instead of growing a second recovery path."""
+
+
 class ServingServer:
     """Threaded front-end: one engine thread, many submitting threads.
 
@@ -156,6 +163,22 @@ class ServingServer:
     ``flight``: an optional :class:`~gradaccum_tpu.obs.flight.
     FlightRecorder` — every recovered engine fault, the give-up path, and
     a watchdog fire each dump the recent-event ring as a postmortem.
+    ``sentinel``: an optional :class:`~gradaccum_tpu.obs.sentinel.
+    Sentinel` — the loop feeds it heartbeats and tick durations (per
+    replica behind a :class:`~gradaccum_tpu.serving.replicated.
+    ReplicatedEngine`), faults are noted on it, and its remediation
+    callbacks can call :meth:`request_recover` to route a recovery through
+    the existing fault contract.
+    ``slo``: an optional :class:`~gradaccum_tpu.obs.slo.SLOEvaluator`,
+    bound to the engine's registry and ticked once per clean engine tick
+    (set the evaluator's ``interval`` to throttle percentile pulls to a
+    scrape-like cadence).
+    ``telemetry_port``: when set (0 = ephemeral), :meth:`start` brings up
+    the embedded ops endpoints (:class:`~gradaccum_tpu.obs.telemetry.
+    TelemetryServer`): ``/metrics`` scrapes the engine registry,
+    ``/healthz``/``/readyz`` reflect loop + fault + drain state, ``/varz``
+    is :meth:`stats`, ``/trace`` the live span ring. None (the default)
+    binds nothing — the no-telemetry server is byte-for-byte the old one.
     """
 
     def __init__(
@@ -166,9 +189,33 @@ class ServingServer:
         max_engine_faults: int = 3,
         watchdog_timeout: Optional[float] = None,
         flight=None,
+        sentinel=None,
+        slo=None,
+        telemetry_port: Optional[int] = None,
+        telemetry_host: str = "127.0.0.1",
     ):
         self._engine = engine
         self._flight = flight
+        self._sentinel = sentinel
+        self._slo = slo
+        # the engine's metrics registry: a ReplicatedEngine owns ONE shared
+        # fleet registry directly (its .metrics facade has none); a single
+        # Engine reaches it through ServingMetrics
+        self._registry = (getattr(engine, "registry", None)
+                          or getattr(engine.metrics, "registry", None))
+        if slo is not None and self._registry is not None:
+            slo.bind_registry(self._registry)
+        self._telemetry_port = telemetry_port
+        self._telemetry_host = telemetry_host
+        self._telemetry = None
+        # a sentinel remediation's recover request, honored by the loop
+        # thread at its next iteration (guarded by _hlock)
+        self._nudge: Optional[str] = None
+        # a fleet engine forwards per-replica heartbeats itself; the
+        # server only feeds engine-level signals for single engines
+        if sentinel is not None and hasattr(engine, "replicas") \
+                and getattr(engine, "sentinel", None) is None:
+            engine.sentinel = sentinel
         self._idle_sleep = idle_sleep
         self._max_requeues = max_requeues
         self._max_engine_faults = max_engine_faults
@@ -203,7 +250,74 @@ class ServingServer:
         self._thread.start()
         if self._watchdog is not None:
             self._watchdog.start()
+        if self._sentinel is not None \
+                and self._sentinel.check_interval is not None:
+            # the background checker is the lease backstop for a loop
+            # wedged INSIDE a tick (which never reaches its own check)
+            self._sentinel.start()
+        if self._telemetry_port is not None:
+            from gradaccum_tpu.obs.telemetry import TelemetryServer
+
+            self._telemetry = TelemetryServer(
+                port=self._telemetry_port, host=self._telemetry_host,
+                registry=self._registry,
+                tracer=self._engine._tracer,
+                varz=self.stats,
+                health=self._health,
+                ready=self._ready,
+                slo=self._slo,
+                sentinel=self._sentinel,
+            ).start()
         return self
+
+    @property
+    def telemetry(self):
+        """The live :class:`TelemetryServer` (None when not configured) —
+        read ``server.telemetry.port`` for the bound ephemeral port."""
+        return self._telemetry
+
+    def _health(self):
+        """Liveness: the engine thread exists and has not died. A server
+        that gave up (``_error`` set) is no longer alive — restarting the
+        process is the only way back, which is exactly what an orchestrator
+        should conclude from a failing liveness probe."""
+        with self._hlock:
+            error = self._error
+        alive = self._thread is not None and self._thread.is_alive()
+        detail = {
+            "engine_thread": bool(alive),
+            "consecutive_faults": self._faults,
+            "tick": self._engine.tick_count,
+            "error": None if error is None else repr(error),
+        }
+        return (alive and error is None), detail
+
+    def _ready(self):
+        """Readiness: healthy AND accepting traffic — not draining
+        (``stop()`` flips ``_stop`` before joining, so a draining server
+        goes unready first) and not poisoned by a fault give-up. Fault
+        state shows as ``consecutive_faults`` so an operator can watch a
+        server fight its budget before it goes unhealthy."""
+        ok, detail = self._health()
+        draining = self._stop.is_set()
+        detail["draining"] = draining
+        if self._sentinel is not None:
+            firing = self._sentinel.firing()
+            detail["anomalies_firing"] = [
+                {"kind": k, "replica": r} for k, r in firing
+            ]
+            ok = ok and not firing
+        return (ok and not draining), detail
+
+    def request_recover(self, reason: str) -> None:
+        """Ask the loop thread to run the engine-fault recovery path
+        (recover → bounded requeue → flight dump) at its next iteration —
+        the sentinel remediation entry point. Safe from any thread; a
+        no-op if the server already failed. The loop must be alive to
+        honor it: a loop wedged inside a tick is the watchdog's job."""
+        with self._hlock:
+            if self._error is None and self._nudge is None:
+                self._nudge = reason
 
     def stop(self) -> None:
         """Stop the loop and close the engine. Re-raises (wrapped) any
@@ -213,6 +327,13 @@ class ServingServer:
         (it holds ``_lock``, so closing the engine would deadlock) rather
         than hanging ``stop()`` forever."""
         self._stop.set()
+        # the ops plane goes down first: /readyz already answers unready
+        # (the _stop flag), and a scraper must not race the engine close
+        if self._telemetry is not None:
+            self._telemetry.stop()
+            self._telemetry = None
+        if self._sentinel is not None:
+            self._sentinel.stop()
         wedged = False
         if self._thread is not None:
             join_timeout = (None if self._watchdog is None
@@ -257,6 +378,10 @@ class ServingServer:
         pool = engine.pool
         out = {
             "metrics": engine.metrics.summary(),
+            # the tick stamp makes snapshot consistency CHECKABLE: stats()
+            # holds the engine lock, so a fleet snapshot must show every
+            # replica at the same fleet tick (no torn read mixing ticks)
+            "tick": engine.tick_count,
             "queue_depth": engine.scheduler.depth,
             "admission_stalls": dict(engine.scheduler.stalls),
             "active_slots": pool.active_count,
@@ -301,6 +426,7 @@ class ServingServer:
             per = [self._engine_stats(e) for e in replicas]
             out = {
                 "replicas": len(replicas),
+                "tick": engine.tick_count,
                 "queue_depth": sum(p["queue_depth"] for p in per),
                 "active_slots": sum(p["active_slots"] for p in per),
                 "num_slots": sum(p["num_slots"] for p in per),
@@ -403,6 +529,11 @@ class ServingServer:
             tr.event("serve/engine_fault", cat="resilience",
                      error=type(exc).__name__,
                      consecutive=self._faults, give_up=give_up)
+        if self._sentinel is not None \
+                and not isinstance(exc, SentinelRemediation):
+            # real faults land in the anomaly log; a sentinel-requested
+            # recover does not re-note itself (it IS the remediation)
+            self._sentinel.note_fault(error=type(exc).__name__)
         with self._hlock:
             known = list(self._handles)
         retired = []
@@ -493,11 +624,19 @@ class ServingServer:
                 pass
 
     def _loop(self) -> None:
+        snt = self._sentinel
         try:
             while not self._stop.is_set():
                 with self._hlock:
                     if self._error is not None:
                         return  # stall/give-up already failed the handles
+                    nudge, self._nudge = self._nudge, None
+                if nudge is not None:
+                    # a sentinel remediation: run the PROVEN fault path —
+                    # recover, bounded requeue, flight dump — on the loop
+                    # thread, where the engine lock is safe to take
+                    self._handle_engine_fault(SentinelRemediation(nudge))
+                    continue
                 try:
                     with self._lock:
                         if self._engine.idle:
@@ -505,6 +644,7 @@ class ServingServer:
                         else:
                             if self._watchdog is not None:
                                 self._watchdog.arm()
+                            t0 = time.monotonic() if snt is not None else 0.0
                             try:
                                 events = self._engine.step()
                             finally:
@@ -514,9 +654,25 @@ class ServingServer:
                     self._handle_engine_fault(e)
                     continue
                 if events is None:
+                    if snt is not None:
+                        # an idle engine is not stalled: park the lease
+                        snt.heartbeat(tick=self._engine.tick_count,
+                                      busy=False)
+                        snt.check()
                     self._stop.wait(self._idle_sleep)
                     continue
                 self._faults = 0  # a clean tick resets the consecutive budget
+                if snt is not None:
+                    # fleet engines heartbeat per replica from their own
+                    # step(); the engine-level signals cover the single
+                    # engine and the fleet's aggregate tick cost
+                    if not hasattr(self._engine, "replicas"):
+                        snt.heartbeat(tick=self._engine.tick_count,
+                                      busy=not self._engine.idle)
+                    snt.observe_tick(time.monotonic() - t0)
+                    snt.check()
+                if self._slo is not None:
+                    self._slo.tick()
                 for rid, tok in events.emitted:
                     handle = self._handles.get(rid)
                     if handle is not None:

@@ -68,10 +68,25 @@ class LatencySeries:
     everywhere (mean / p50 / p90 / p99 / count). Shared by
     serving/metrics.py, the obs metrics registry's histograms, and
     examples/bench_serving.py so every artifact quotes percentiles computed
-    the same way (numpy linear interpolation)."""
+    the same way (numpy linear interpolation).
 
-    def __init__(self):
-        self._xs = []
+    ``window=N`` bounds the series to the most recent N samples (a ring):
+    percentiles then describe CURRENT behavior instead of everything since
+    boot — what SLO evaluation needs, where a cumulative p99 would bury a
+    fresh latency cliff under hours of healthy history. The default
+    (``window=None``) keeps every sample, exactly as before.
+    """
+
+    def __init__(self, window=None):
+        if window is not None and int(window) < 1:
+            raise ValueError(f"window must be >= 1 samples, got {window}")
+        self.window = None if window is None else int(window)
+        if self.window is None:
+            self._xs = []
+        else:
+            from collections import deque
+
+            self._xs = deque(maxlen=self.window)
 
     def add(self, x: float) -> None:
         self._xs.append(float(x))
@@ -79,18 +94,38 @@ class LatencySeries:
     def extend(self, xs) -> None:
         self._xs.extend(float(x) for x in xs)
 
+    def samples(self) -> list:
+        """A copy of the current samples (the whole ring when windowed) —
+        for consumers that merge several series (e.g. a fleet-wide
+        percentile over per-replica latency series)."""
+        return list(self._xs)
+
     def __len__(self) -> int:
         return len(self._xs)
 
     def percentiles(self, qs=(50, 90, 99)) -> dict:
         """``{"p50": ..., "p90": ..., ...}`` for the requested quantiles
-        (None-valued when the series is empty)."""
+        (None-valued when the series is empty).
+
+        Computed as numpy's default linear interpolation — ``pos = (n-1) *
+        q/100`` between the two bracketing order statistics — but by hand:
+        ``np.percentile`` spends ~60 µs/call on argument handling, which
+        the SLO evaluator would pay per objective per tick; the direct
+        sort+lerp is the same arithmetic at a fraction of the cost."""
         import numpy as np
 
         if not self._xs:
             return {f"p{q:g}": None for q in qs}
-        a = np.asarray(self._xs, np.float64)
-        return {f"p{q:g}": float(np.percentile(a, q)) for q in qs}
+        a = np.fromiter(self._xs, np.float64, len(self._xs))
+        a.sort()
+        n = a.size
+        out = {}
+        for q in qs:
+            pos = (n - 1) * (float(q) / 100.0)
+            lo = int(pos)
+            hi = min(lo + 1, n - 1)
+            out[f"p{q:g}"] = float(a[lo] + (a[hi] - a[lo]) * (pos - lo))
+        return out
 
     def summary(self) -> dict:
         import numpy as np

@@ -29,3 +29,8 @@ def test_chaos_smoke_passes_and_refreshes_artifact():
     assert artifact["acceptance"]["passed"] is True
     assert artifact["detail"]["train"]["crashes"] >= 1
     assert artifact["detail"]["serve"]["requests"] == 6
+    ops = artifact["detail"]["ops"]
+    assert ops["sim_determinism"]["byte_identical"] is True
+    assert ops["serve"]["fault_to_alert"] == {
+        "crash": "engine_fault", "slow_tick": "latency_cliff"}
+    assert ops["train"]["drained_at_step"] is not None

@@ -403,10 +403,14 @@ def test_registry_prometheus_families_are_contiguous():
         reg.histogram("serving/ttft", labels={"replica": rep}).observe(0.5)
     current = None
     seen = set()
+    helped = set()
     for line in reg.to_prometheus().strip().split("\n"):
-        if line.startswith("# TYPE "):
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+        elif line.startswith("# TYPE "):
             current = line.split()[2]
             assert current not in seen  # one TYPE line per family
+            assert current in helped  # HELP precedes its TYPE line
             seen.add(current)
         else:
             base = line.split("{")[0].split(" ")[0]
@@ -415,6 +419,24 @@ def test_registry_prometheus_families_are_contiguous():
             assert base == current  # every sample sits under ITS type line
     assert seen == {"serving_tokens_total", "serving_queue_depth",
                     "serving_ttft"}
+
+
+def test_registry_prometheus_help_and_label_escaping():
+    """# HELP rides every family (registered text or the name), and label
+    values with backslash/quote/newline stay exposition-valid."""
+    from gradaccum_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("hits_total", help="total cache hits\nsecond line").inc()
+    reg.gauge("depth", labels={"mesh": 'model="2",\\dp\n4'}).set(1.0)
+    text = reg.to_prometheus()
+    assert "# HELP hits_total total cache hits\\nsecond line" in text
+    assert "# HELP depth depth" in text  # fallback: the family name
+    assert '{mesh="model=\\"2\\",\\\\dp\\n4"}' in text
+    assert "\n\n" not in text  # escaping kept every sample on one line
+    # help text from a later registration never clobbers the first
+    reg.counter("hits_total", help="other").inc()
+    assert "total cache hits" in reg.to_prometheus()
 
 
 def test_serving_metrics_absorbed_into_registry(tiny_lm):

@@ -1,0 +1,756 @@
+"""Live ops plane: SLO burn-rate alerts, anomaly sentinel, telemetry HTTP.
+
+The load-bearing gates: (1) multi-window burn-rate semantics — an alert
+fires only when EVERY window burns past its factor, resolves when any
+recovers, and a seeded deterministic-clock stream serializes to
+byte-identical alert JSON; (2) the sentinel's detect→remediate loop runs
+through the EXISTING recovery contract (``ServingServer.request_recover``
+→ recover + requeue with token parity, ``DrainConsensus.request`` →
+agreed drain); (3) the embedded telemetry endpoints answer correctly and
+flip readiness with fault/drain state; (4) ``ServingServer.stats()``
+snapshots are internally consistent under concurrent fleet ticks.
+"""
+
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+pytestmark = pytest.mark.slo
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(
+        jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)}
+    )
+    return cfg, bundle, params
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+# -- burn-rate semantics ------------------------------------------------------
+
+
+def _objective(**overrides):
+    from gradaccum_tpu.obs.slo import Objective
+
+    kwargs = dict(name="t", metric="m", threshold=1.0, target=0.9,
+                  windows=((10.0, 1.0), (4.0, 1.0)))
+    kwargs.update(overrides)
+    return Objective(**kwargs)
+
+
+def test_alert_fires_only_when_every_window_burns():
+    """The short window trips on a burst; the alert must wait for the
+    long window too (one blip cannot page), then resolve on recovery."""
+    from gradaccum_tpu.obs.slo import SLOEvaluator
+
+    o = _objective(windows=((20.0, 2.0), (4.0, 1.0)))
+    ev = SLOEvaluator([o], clock=lambda: 0.0)
+    for t in range(16):
+        ev.observe("t", 0.5, now=float(t))
+    # a 2-bad burst: the short window burns at 5x its budget, but the
+    # long window's burn (2 bad / 18 samples / 0.1) stays under its 2x
+    # factor, so nothing fires
+    ev.observe("t", 9.0, now=16.0)
+    ev.observe("t", 9.0, now=17.0)
+    assert ev.firing() == []
+    # sustained violation: both windows burn -> fire; recovery resolves
+    for t in range(18, 26):
+        ev.observe("t", 9.0, now=float(t))
+    assert ev.firing() == ["t"]
+    for t in range(26, 60):
+        ev.observe("t", 0.5, now=float(t))
+    assert ev.firing() == []
+    assert [a["state"] for a in ev.alerts] == ["fire", "resolve"]
+
+
+def test_alert_stream_byte_identical_and_op_directions():
+    from gradaccum_tpu.obs.slo import SLOEvaluator
+
+    def run():
+        ev = SLOEvaluator(
+            [_objective(name="hi", op="<="),
+             _objective(name="lo", metric="m2", op=">=", threshold=5.0)],
+            clock=lambda: 0.0,
+        )
+        for t in range(40):
+            bad = 10 <= t < 20
+            ev.observe("hi", 9.0 if bad else 0.5, now=float(t))
+            ev.observe("lo", 1.0 if bad else 9.0, now=float(t))
+        return ev
+
+    a, b = run(), run()
+    assert a.alerts_bytes() == b.alerts_bytes()
+    assert {x["slo"] for x in a.alerts} == {"hi", "lo"}
+    assert a.alerts_bytes().startswith(b"[{")
+
+
+def test_objective_validation_and_spec_roundtrip(tmp_path):
+    from gradaccum_tpu.obs.slo import Objective, load_spec
+
+    with pytest.raises(ValueError, match="target"):
+        _objective(target=1.0)
+    with pytest.raises(ValueError, match="op"):
+        _objective(op="<")
+    with pytest.raises(ValueError, match="window"):
+        _objective(windows=())
+    o = _objective(event="req/queue")
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({"objectives": [o.to_dict()]}))
+    (loaded,) = load_spec(str(spec))
+    assert loaded == o
+    with pytest.raises(ValueError, match="objectives"):
+        load_spec({"objectives": []})
+
+
+def test_evaluator_pulls_gauge_counter_rate_and_windowed_percentile():
+    from gradaccum_tpu.obs.metrics import MetricsRegistry
+    from gradaccum_tpu.obs.slo import (
+        KIND_COUNTER_RATE,
+        KIND_PERCENTILE,
+        SLOEvaluator,
+    )
+    from gradaccum_tpu.utils.timing import LatencySeries
+
+    reg = MetricsRegistry()
+    reg.gauge("depth").set(3.0)
+    c = reg.counter("req_total")
+    series = LatencySeries(window=8)
+    reg.histogram("lat", series=series)
+    ev = SLOEvaluator(
+        [_objective(name="depth", metric="depth", threshold=10.0),
+         _objective(name="rate", metric="req_total", threshold=5.0,
+                    kind=KIND_COUNTER_RATE),
+         _objective(name="p99", metric="lat", threshold=1.0,
+                    kind=KIND_PERCENTILE, percentile=99.0)],
+        registry=reg, clock=lambda: 0.0,
+    )
+    for x in (0.1,) * 20 + (50.0,) * 8:  # the window forgets the cheap past
+        series.add(x)
+    ev.tick(now=0.0)  # primes the counter rate
+    c.inc(30)
+    ev.tick(now=10.0)
+    t = ev.trackers
+    assert t["depth"].last_value == 3.0
+    assert t["rate"].last_value == pytest.approx(3.0)  # 30 in 10 ticks
+    # a cumulative p99 would still remember the 0.1s; the window must not
+    assert t["p99"].last_value == pytest.approx(50.0)
+
+
+def test_evaluator_aggregates_labeled_fleet_instruments():
+    """A fleet registers one labeled instrument per replica under the
+    same family: counter-rate objectives must see the SUMMED fleet rate
+    and percentile objectives the merged per-replica samples — never
+    just whichever replica registered first."""
+    from gradaccum_tpu.obs.metrics import MetricsRegistry
+    from gradaccum_tpu.obs.slo import (
+        KIND_COUNTER_RATE,
+        KIND_PERCENTILE,
+        SLOEvaluator,
+    )
+
+    reg = MetricsRegistry()
+    counters = [reg.counter("tok_total", labels={"replica": str(r)})
+                for r in range(4)]
+    hists = [reg.histogram("lat", labels={"replica": str(r)})
+             for r in range(2)]
+    ev = SLOEvaluator(
+        [_objective(name="rate", metric="tok_total", threshold=10.0,
+                    op=">=", kind=KIND_COUNTER_RATE),
+         _objective(name="p99", metric="lat", threshold=1.0,
+                    kind=KIND_PERCENTILE, percentile=99.0)],
+        registry=reg, clock=lambda: 0.0,
+    )
+    ev.tick(now=0.0)  # primes the rate
+    for c in counters:
+        c.inc(30)  # fleet rate 12/tick; replica 0 alone would read 3
+    hists[0].observe(0.5)
+    hists[1].observe(9.0)  # the cliff lives on replica 1
+    ev.tick(now=10.0)
+    assert ev.trackers["rate"].last_value == pytest.approx(12.0)
+    assert ev.trackers["p99"].last_value == pytest.approx(9.0 - 0.085)
+
+
+def test_evaluator_status_safe_against_concurrent_ticks():
+    """/slo is served from handler threads while the loop ticks: status()
+    must never see a deque mid-mutation."""
+    from gradaccum_tpu.obs.slo import SLOEvaluator
+
+    ev = SLOEvaluator([_objective(windows=((8.0, 1.0), (2.0, 1.0)))],
+                      clock=lambda: 0.0)
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                ev.status(now=1e9)  # evicts aggressively while iterating
+                ev.alerts_bytes()
+                ev.firing()
+            except Exception as e:  # noqa: BLE001 — the failure under test
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for i in range(4000):
+        ev.observe("t", 0.5 if i % 3 else 9.0, now=float(i))
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
+def test_evaluator_tick_interval_throttles_pulls():
+    from gradaccum_tpu.obs.metrics import MetricsRegistry
+    from gradaccum_tpu.obs.slo import SLOEvaluator
+
+    reg = MetricsRegistry()
+    reg.gauge("depth").set(3.0)
+    ev = SLOEvaluator([_objective(name="d", metric="depth")],
+                      registry=reg, clock=lambda: 0.0, interval=4)
+    for t in range(12):
+        ev.tick(now=float(t))
+    assert ev.trackers["d"].samples == 3  # ticks 0, 4, 8
+
+
+# -- windowed LatencySeries (the satellite's edge) ----------------------------
+
+
+def test_latency_series_window_edge():
+    from gradaccum_tpu.utils.timing import LatencySeries
+
+    s = LatencySeries(window=10)
+    s.extend(range(1, 11))  # exactly full: nothing evicted yet
+    assert s.percentiles((50,))["p50"] == pytest.approx(5.5)
+    s.add(1000.0)  # the 11th sample evicts "1"
+    assert len(s) == 10
+    assert s.summary()["count"] == 10
+    assert s.percentiles((50,))["p50"] == pytest.approx(6.5)
+    # cumulative default unchanged
+    c = LatencySeries()
+    c.extend(range(1, 12))
+    assert len(c) == 11
+    with pytest.raises(ValueError):
+        LatencySeries(window=0)
+
+
+def test_serving_metrics_latency_window_bounds_slo_percentiles():
+    from gradaccum_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics(latency_window=4)
+    for i, rid in enumerate(range(8)):
+        m.record_submit(rid)
+        m.record_token(rid, first=True)
+        m.ttft._xs[-1] = float(i)  # deterministic values for the check
+    assert len(m.ttft) == 4
+    assert m.queue_wait.window == 4
+    # the registry histogram reads the SAME bounded series
+    _, h = m.registry.find("serving/ttft")
+    assert h.series is m.ttft
+
+
+# -- sentinel -----------------------------------------------------------------
+
+
+def test_sentinel_latency_cliff_fires_once_resolves_and_remediates():
+    from gradaccum_tpu.obs.sentinel import LATENCY_CLIFF, Sentinel
+
+    hits = []
+    snt = Sentinel(clock=lambda: 0.0, cliff_warmup=4, cliff_consecutive=2)
+    snt.on(LATENCY_CLIFF, lambda a: hits.append((a.kind, a.replica)))
+    for i in range(8):
+        snt.observe_tick(1e-3, now=float(i))
+    snt.observe_tick(0.5, now=8.0)
+    assert snt.firing() == []  # one slow tick is not a cliff
+    for t in (9.0, 10.0, 11.0):
+        snt.observe_tick(0.5, now=t)
+    assert snt.firing() == [(LATENCY_CLIFF, None)]
+    assert hits == [(LATENCY_CLIFF, None)]  # level-held: fired exactly once
+    snt.observe_tick(1e-3, now=12.0)
+    assert snt.firing() == []
+    states = [(a.kind, a.state) for a in snt.anomalies]
+    assert states == [(LATENCY_CLIFF, "fire"), (LATENCY_CLIFF, "resolve")]
+
+
+def test_sentinel_cliff_samples_do_not_poison_baseline():
+    """Slow samples must not feed the EWMA, or a sustained cliff would
+    drag the baseline up and mask itself."""
+    from gradaccum_tpu.obs.sentinel import Sentinel
+
+    snt = Sentinel(clock=lambda: 0.0, cliff_warmup=4, cliff_consecutive=2)
+    for i in range(8):
+        snt.observe_tick(1e-3, now=float(i))
+    mean_before = snt._tick_base[None].mean
+    for t in range(8, 20):
+        snt.observe_tick(0.5, now=float(t))
+    assert snt._tick_base[None].mean == mean_before
+
+
+def test_sentinel_heartbeat_lease_distinguishes_slow_from_gone():
+    from gradaccum_tpu.obs.sentinel import DEAD_REPLICA, STALL, Sentinel
+
+    snt = Sentinel(clock=lambda: 0.0, lease=5.0)
+    snt.heartbeat(tick=3, busy=True, now=0.0)            # the single engine
+    snt.heartbeat(replica=1, tick=3, busy=True, now=0.0)  # a fleet replica
+    snt.heartbeat(replica=2, tick=3, busy=False, now=0.0)  # idle: parked
+    assert snt.check(now=4.0) == []  # within the lease
+    fired = snt.check(now=6.0)
+    assert {(a.kind, a.replica) for a in fired} == \
+        {(STALL, None), (DEAD_REPLICA, 1)}  # idle replica 2 never fires
+    assert snt.check(now=7.0) == []  # level-held
+    snt.heartbeat(replica=1, tick=4, busy=True, now=8.0)  # came back
+    assert (DEAD_REPLICA, 1) not in snt.firing()
+    assert (STALL, None) in snt.firing()
+
+
+def test_sentinel_scale_storm_and_drain_remediation():
+    """A halving storm fires scale_storm whose stock remediation requests
+    a drain through the consensus contract (the SIGTERM path)."""
+    from gradaccum_tpu.obs.sentinel import SCALE_STORM, Sentinel
+    from gradaccum_tpu.resilience import remediation
+    from gradaccum_tpu.resilience.preemption import DrainConsensus
+
+    snt = Sentinel(clock=lambda: 0.0, storm_halvings=3, storm_window=32.0)
+    consensus = DrainConsensus(multiprocess=False)
+    remediation.bind_default_remediations(snt, consensus=consensus)
+    assert consensus.decide(False, 0) == (False, 0)
+    scale = 1024.0
+    for t in range(6):  # halve, halve, halve -> storm
+        snt.observe_scale(scale, now=float(2 * t))
+        scale /= 2
+    assert snt.firing() == [(SCALE_STORM, None)]
+    assert consensus.decide(False, 7) == (True, 7)  # the drain was requested
+
+
+def test_sentinel_fire_lands_tracer_event_flight_dump_and_counter(tmp_path):
+    from gradaccum_tpu.obs import flight as obs_flight
+    from gradaccum_tpu.obs.metrics import MetricsRegistry
+    from gradaccum_tpu.obs.sentinel import Sentinel
+    from gradaccum_tpu.obs.trace import Tracer
+
+    tracer = Tracer(deterministic=True, capacity=None)
+    reg = MetricsRegistry()
+    recorder = obs_flight.FlightRecorder(str(tmp_path), tracer=tracer,
+                                         registry=reg)
+    snt = Sentinel(clock=lambda: 0.0, tracer=tracer, flight=recorder,
+                   registry=reg, lease=1.0)
+    snt.heartbeat(replica=0, tick=1, busy=True, now=0.0)
+    snt.check(now=5.0)
+    names = [e["name"] for e in tracer.snapshot()]
+    assert "sentinel/anomaly" in names
+    dumps = obs_flight.list_dumps(str(tmp_path))
+    assert len(dumps) == 1 and "sentinel-dead_replica" in dumps[0]
+    payload = obs_flight.load_dump(dumps[0])
+    assert payload["extra"]["kind"] == "dead_replica"
+    snap = reg.snapshot()
+    assert snap["counters"]['sentinel/anomalies_total{kind="dead_replica"}'] \
+        == 1
+
+
+def test_sentinel_remediation_errors_are_contained():
+    from gradaccum_tpu.obs.sentinel import STALL, Sentinel
+    from gradaccum_tpu.obs.trace import Tracer
+
+    tracer = Tracer(deterministic=True, capacity=None)
+    ran = []
+    snt = Sentinel(clock=lambda: 0.0, tracer=tracer, lease=1.0)
+    snt.on(STALL, lambda a: (_ for _ in ()).throw(RuntimeError("boom")))
+    snt.on("*", lambda a: ran.append(a.kind))
+    snt.heartbeat(tick=1, busy=True, now=0.0)
+    fired = snt.check(now=5.0)  # must not raise
+    assert [a.kind for a in fired] == [STALL]
+    assert ran == [STALL]  # later callbacks still ran
+    errors = [e["args"].get("error") for e in tracer.snapshot()
+              if e["name"] == "sentinel/remediation"]
+    assert "RuntimeError" in errors
+
+
+def test_sentinel_anomaly_log_byte_identical():
+    from gradaccum_tpu.obs.sentinel import Sentinel
+
+    def run():
+        snt = Sentinel(clock=lambda: 0.0, cliff_warmup=4,
+                       cliff_consecutive=2, lease=4.0)
+        for t in range(8):
+            snt.observe_tick(1e-3, now=float(t))
+            snt.heartbeat(tick=t, busy=True, now=float(t))
+        for t in range(8, 12):
+            snt.observe_tick(0.75, now=float(t))
+        snt.check(now=20.0)
+        return snt.anomalies_bytes()
+
+    assert run() == run()
+
+
+# -- the server loop: remediation through the existing contract ---------------
+
+
+def test_request_recover_requeues_with_token_parity(tiny_lm):
+    """A sentinel-style recover request mid-stream: the in-flight request
+    rides the PROVEN requeue path and still matches solo decode."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.obs.trace import Tracer, installed
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    tracer = Tracer(capacity=None)
+    engine = Engine(params, cfg, num_slots=2, max_len=64, tracer=tracer)
+    prompt = np.asarray([3, 1, 4, 1, 5], np.int32)
+    with installed(tracer):
+        server = ServingServer(engine, max_requeues=2).start()
+        handle = server.submit(prompt, 16)
+        server.request_recover("test:latency_cliff")
+        tokens, reason = handle.result(timeout=120)
+        server.stop()
+    assert reason == "length"
+    want = np.asarray(generate_cached(params, cfg, prompt, 16))
+    np.testing.assert_array_equal(np.asarray(tokens), want[0, prompt.size:])
+    names = [e["name"] for e in tracer.snapshot()]
+    assert "serve/recover" in names  # the existing contract did the work
+    faults = [e for e in tracer.snapshot()
+              if e["name"] == "serve/engine_fault"]
+    assert any(e["args"]["error"] == "SentinelRemediation" for e in faults)
+
+
+def test_server_notes_real_faults_on_sentinel(tiny_lm):
+    from gradaccum_tpu.obs.sentinel import ENGINE_FAULT, Sentinel
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    snt = Sentinel()
+    schedule = faults.FaultSchedule(
+        [faults.FaultSpec(faults.MID_DECODE_TICK, at=1)]
+    )
+    with faults.installed(faults.FaultInjector(schedule)):
+        server = ServingServer(engine, max_requeues=2, sentinel=snt).start()
+        handle = server.submit(np.asarray([1, 2, 3], np.int32), 5)
+        tokens, reason = handle.result(timeout=120)
+        server.stop()
+    assert reason in ("eos", "length")
+    fired = [a for a in snt.anomalies if a.state == "fire"]
+    assert [a.kind for a in fired] == [ENGINE_FAULT]
+    assert fired[0].detail["error"] == "InjectedCrash"
+
+
+# -- telemetry endpoints ------------------------------------------------------
+
+
+def test_telemetry_endpoints_through_serving_server(tiny_lm):
+    from gradaccum_tpu.obs.sentinel import Sentinel
+    from gradaccum_tpu.obs.slo import SLOEvaluator, default_serving_objectives
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    server = ServingServer(
+        engine, sentinel=Sentinel(),
+        slo=SLOEvaluator(default_serving_objectives()),
+        telemetry_port=0,
+    ).start()
+    try:
+        tel = server.telemetry
+        assert tel is not None and tel.port
+        handle = server.submit(np.asarray([1, 2, 3], np.int32), 4)
+        handle.result(timeout=120)
+
+        status, body = _get(tel.url("/metrics"))
+        assert status == 200
+        assert "# HELP" in body and "# TYPE" in body
+        assert "serving_tokens_emitted_total" in body
+
+        status, body = _get(tel.url("/healthz"))
+        assert status == 200 and json.loads(body)["ok"] is True
+
+        status, body = _get(tel.url("/readyz"))
+        assert status == 200
+        ready = json.loads(body)
+        assert ready["draining"] is False
+        assert ready["anomalies_firing"] == []
+
+        status, body = _get(tel.url("/varz"))
+        varz = json.loads(body)
+        assert varz["num_slots"] == 2
+        assert varz["metrics"]["tokens_emitted"] == 4
+
+        status, body = _get(tel.url("/trace"))
+        assert {"serve/tick", "req/decode"} <= \
+            {e["name"] for e in json.loads(body)["traceEvents"]}
+
+        status, body = _get(tel.url("/slo"))
+        assert "serve/ttft_p99" in json.loads(body)["objectives"]
+
+        status, body = _get(tel.url("/sentinel"))
+        assert json.loads(body)["firing"] == []
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(tel.url("/nope"))
+        assert e.value.code == 404
+        url = tel.url("/healthz")
+    finally:
+        server.stop()
+    assert server.telemetry is None  # the ops plane went down with stop()
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(url, timeout=2)
+
+
+def test_readiness_flips_on_fault_giveup(tiny_lm):
+    """A server that exhausted its fault budget answers 503 on both
+    probes — the orchestrator's signal to replace it."""
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=1, max_len=32)
+    # a faulted tick never advances the tick counter, so count=4 makes the
+    # SAME replayed tick fault consecutively and blow the fault budget
+    schedule = faults.FaultSchedule(
+        [faults.FaultSpec(faults.MID_DECODE_TICK, at=None, count=4)]
+    )
+    with faults.installed(faults.FaultInjector(schedule)):
+        server = ServingServer(engine, max_requeues=5, max_engine_faults=1,
+                               telemetry_port=0).start()
+        tel = server.telemetry
+        handle = server.submit(np.asarray([1, 2], np.int32), 4)
+        with pytest.raises(RuntimeError):
+            handle.result(timeout=120)
+        for probe in ("/healthz", "/readyz"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(tel.url(probe))
+            assert e.value.code == 503, probe
+        with pytest.raises(RuntimeError):
+            server.stop()
+
+
+# -- stats() consistency under concurrent fleet ticks -------------------------
+
+
+@pytest.mark.multichip
+def test_stats_snapshot_consistent_under_concurrent_fleet_ticks(tiny_lm):
+    """per_replica must never mix two ticks' gauges: stats() holds the
+    engine lock, so every replica shows the SAME fleet tick and the
+    aggregates equal the per-replica sums — hammered from threads while
+    the fleet serves real traffic on its replica pool."""
+    from gradaccum_tpu.serving import ServingServer
+    from gradaccum_tpu.serving.replicated import ReplicatedEngine
+
+    cfg, _, params = tiny_lm
+    fleet = ReplicatedEngine(params, cfg, replicas=2, tp=1,
+                             num_slots=2, max_len=32)
+    server = ServingServer(fleet).start()
+    rng = np.random.default_rng(7)
+    stop = threading.Event()
+    bad = []
+
+    def hammer():
+        while not stop.is_set():
+            s = server.stats()
+            per = s["per_replica"]
+            if len({p["tick"] for p in per}) != 1:
+                bad.append(("torn tick", [p["tick"] for p in per]))
+            for key in ("queue_depth", "active_slots", "num_slots"):
+                if s[key] != sum(p[key] for p in per):
+                    bad.append((key, s[key], [p[key] for p in per]))
+            for p in per:
+                if not 0 <= p["active_slots"] <= p["num_slots"]:
+                    bad.append(("slots", p["active_slots"]))
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        handles = [
+            server.submit(
+                rng.integers(0, cfg.vocab_size,
+                             size=(int(rng.integers(1, 8)),)).astype(np.int32),
+                int(rng.integers(2, 8)))
+            for _ in range(12)
+        ]
+        for h in handles:
+            h.result(timeout=120)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        server.stop()
+    assert not bad, bad[:5]
+
+
+# -- tools --------------------------------------------------------------------
+
+
+def test_slo_check_replays_trace_and_gates(tiny_lm, tmp_path):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import slo_check
+
+    from gradaccum_tpu.obs.trace import Tracer
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+    tracer = Tracer(deterministic=True, capacity=None)
+    engine = Engine(params, cfg, num_slots=4, max_len=32, tracer=tracer)
+    driver = SimulationDriver(engine, seed=3)
+    driver.run(driver.make_trace(8, arrival_rate=0.6))
+    path = tracer.export(str(tmp_path / "trace.json"))
+
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({"objectives": [{
+        "name": "queue_wait_p99", "metric": "serving/queue_wait",
+        "threshold": 50.0, "target": 0.9,
+        "windows": [[64.0, 1.0], [16.0, 1.0]], "event": "req/queue",
+    }]}))
+    out = tmp_path / "report.json"
+    assert slo_check.main([path, "--spec", str(spec),
+                           "--json", str(out)]) == 0
+    rep = json.loads(out.read_text())["objectives"]["queue_wait_p99"]
+    assert rep["samples"] == 8 and not rep["fired"]
+
+    strict = tmp_path / "strict.json"
+    strict.write_text(json.dumps({"objectives": [{
+        "name": "queue_wait_p99", "metric": "serving/queue_wait",
+        "threshold": -1.0, "target": 0.9,
+        "windows": [[64.0, 1.0], [16.0, 1.0]], "event": "req/queue",
+    }]}))  # every wait violates a negative bound -> must fire
+    assert slo_check.main([path, "--spec", str(strict)]) == 1
+
+
+def test_flight_rotation_caps_dumps_and_report_tolerates_gap(tmp_path):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import obs_report
+
+    from gradaccum_tpu.obs import flight as obs_flight
+    from gradaccum_tpu.obs.trace import Tracer
+
+    tracer = Tracer(deterministic=True, capacity=None)
+    recorder = obs_flight.FlightRecorder(str(tmp_path), tracer=tracer,
+                                         max_dumps=3)
+    for i in range(7):
+        tracer.event("e", cat="x", i=i)
+        recorder.dump(f"r{i}")
+    dumps = obs_flight.list_dumps(str(tmp_path))
+    assert len(dumps) == 3  # capped
+    # oldest-numbered evicted first; numbering climbed over the gap
+    assert [os.path.basename(p)[:9] for p in dumps] == \
+        ["dump-0005", "dump-0006", "dump-0007"]
+    # a later recorder on the same dir keeps counting past the survivors
+    again = obs_flight.FlightRecorder(str(tmp_path), tracer=tracer,
+                                      max_dumps=3)
+    path = again.dump("later")
+    assert os.path.basename(path).startswith("dump-0008")
+    # obs_report merges the gapped directory without complaint
+    events, n_files = obs_report.collect(str(tmp_path))
+    assert n_files == 3  # 0006..0008 after the last rotation
+    assert [e["args"]["i"] for e in events] == list(range(7))
+
+
+@pytest.mark.slow
+def test_slo_check_selftest():
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import slo_check
+
+    assert slo_check.main(["--selftest"]) == 0
+
+
+@pytest.mark.slow
+def test_bench_slo_within_budget(tmp_path):
+    """Slow lane: the ops plane's serve-path overhead gate (<= 1.02x)."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import bench_slo
+
+    out = tmp_path / "BENCH_slo.json"
+    rc = bench_slo.main(["--json", str(out), "--repeats", "3",
+                         "--requests", "32"])
+    artifact = json.loads(out.read_text())
+    assert artifact["acceptance"]["passed"] is True and rc == 0
+    assert artifact["overhead"]["serve"] <= 1.02
+
+
+def test_bench_trend_renders_overhead_block(tmp_path):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import bench_trend
+
+    art = {"bench": "ops plane overhead", "headline": "1.01x",
+           "overhead": {"serve": 1.0123},
+           "acceptance": {"required": "<= 1.02x", "passed": True}}
+    with open(tmp_path / "BENCH_slo.json", "w") as f:
+        json.dump(art, f)
+    rows = bench_trend.collect(str(tmp_path))
+    assert rows[0]["overhead"] == "overhead serve 1.012x"
+    assert bench_trend.main(["--dir", str(tmp_path)]) == 0
+
+
+# -- training-side SLOs -------------------------------------------------------
+
+
+def test_estimator_training_slo_fires_on_nonfinite_skip_storm(tmp_path):
+    """A poisoned-batch storm burns the nonfinite-skip budget: the
+    training SLO fires on the step clock, deterministically."""
+    import jax.numpy as jnp
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.estimator.config import RunConfig
+    from gradaccum_tpu.estimator.estimator import Estimator, ModelBundle
+    from gradaccum_tpu.obs.slo import (
+        SLOEvaluator,
+        default_training_objectives,
+    )
+    from gradaccum_tpu.resilience import faults
+
+    def loss(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    bundle = ModelBundle(
+        init=lambda rng, s: {"w": jnp.zeros((3, 1))},
+        loss=loss,
+        predict=lambda p, b: {"predictions": b["x"] @ p["w"]},
+        eval_metrics={},
+    )
+    rng = np.random.default_rng(0)
+    batches = [{"x": rng.normal(size=(4, 3)).astype(np.float32),
+                "y": rng.normal(size=(4, 1)).astype(np.float32)}
+               for _ in range(32)]
+    slos = SLOEvaluator(
+        default_training_objectives(skip_rate=0.5,
+                                    windows=((8.0, 1.0), (4.0, 1.0))),
+        clock=lambda: 0.0,
+    )
+    est = Estimator(
+        bundle, gt.ops.sgd(0.1),
+        gt.GradAccumConfig(num_micro_batches=4, skip_nonfinite=True),
+        RunConfig(model_dir=str(tmp_path), log_step_count_steps=1,
+                  slos=slos),
+        mode="streaming",
+    )
+    schedule = faults.FaultSchedule([
+        faults.FaultSpec(faults.PRE_TRAIN_STEP, at=i, kind=faults.KIND_NAN)
+        for i in range(8, 20)
+    ])
+    with faults.installed(faults.FaultInjector(schedule)):
+        est.train(batches, max_steps=32)
+    est.close()
+    assert est.nonfinite_skips >= 8
+    fires = [a for a in slos.alerts if a["state"] == "fire"]
+    assert [a["slo"] for a in fires] == ["train/nonfinite_skip_rate"]
+    assert all(float(a["at"]).is_integer() for a in slos.alerts)  # step clock

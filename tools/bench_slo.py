@@ -1,0 +1,156 @@
+"""Measure the live ops plane's serve-path overhead (endpoints off).
+
+The ops plane's contract mirrors PR-6's: with NO telemetry endpoint bound,
+a serving loop carrying a sentinel + SLO evaluator pays only host-side
+dict/deque work per tick — heartbeat, tick-duration baseline update, lease
+check, and one SLO pull — which must stay within 2% of the baseline tick.
+
+Methodology (same shape as ``tools/bench_obs.py``, which showed why A/B
+wall-clock differencing cannot resolve low-single-digit signals on a
+shared CPU): one warmed engine + seeded simulation trace gives the
+uncontended baseline seconds/tick (min over repeats); the ops-plane cost
+is measured DIRECTLY by re-running the exact per-tick call set the
+``ServingServer`` loop adds (``heartbeat`` + ``observe_tick`` + ``check``
++ ``SLOEvaluator.tick`` against the engine's live registry) in a tight
+loop, min over repeats. The gating ratio is ``1 + cost/baseline``. A/B
+wall samples are recorded as a cross-check but do not gate.
+
+Writes ``BENCH_slo.json`` (acceptance: ratio <= 1.02), aggregated by
+``tools/bench_trend.py``.
+
+Usage: python tools/bench_slo.py [--json PATH] [--repeats N]
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+REQUIRED = ("ops plane attached but endpoints off: <= 2% overhead per "
+            "serving tick (measured cost of the per-tick sentinel + SLO "
+            "call set over the uncontended baseline tick, CPU)")
+
+
+def _rebased(trace, base: int):
+    return [dataclasses.replace(it, arrival_tick=it.arrival_tick + base)
+            for it in trace]
+
+
+def _setup(seed: int, n_requests: int):
+    import jax
+    import numpy as np
+
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.obs.trace import NullTracer
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+    from gradaccum_tpu.serving.metrics import ServingMetrics
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(jax.random.PRNGKey(0),
+                         {"input_ids": np.zeros((1, 8), np.int32)})
+    # the recommended live-SLO config: windowed latency series, so the
+    # percentile objectives sort 256 samples, not everything since boot
+    engine = Engine(params, cfg, num_slots=4, max_len=32,
+                    tracer=NullTracer(),
+                    metrics=ServingMetrics(latency_window=256))
+    driver = SimulationDriver(engine, seed=seed)
+    trace = driver.make_trace(n_requests, arrival_rate=0.6,
+                              prompt_len=(1, 12), max_new=(4, 12))
+    driver.run(_rebased(trace, engine.tick_count))  # warmup: compile all
+    return engine, driver, trace
+
+
+def _baseline_leg(engine, driver, trace):
+    t0_ticks = engine.tick_count
+    t0 = time.perf_counter()
+    driver.run(_rebased(trace, engine.tick_count))
+    dt = time.perf_counter() - t0
+    return dt / max(engine.tick_count - t0_ticks, 1)
+
+
+def _ops_plane_cost(engine, ticks: int, repeats: int,
+                    slo_interval: int = 4) -> float:
+    """Seconds/tick of the EXACT call set the serving loop adds when a
+    sentinel + SLO evaluator are attached with endpoints off
+    (``slo_interval`` throttles percentile pulls, the documented
+    scrape-cadence knob)."""
+    from gradaccum_tpu.obs.sentinel import Sentinel
+    from gradaccum_tpu.obs.slo import SLOEvaluator, default_serving_objectives
+
+    best = float("inf")
+    for _ in range(max(repeats, 3)):
+        clock = [0.0]
+        snt = Sentinel(clock=lambda: clock[0])
+        slo = SLOEvaluator(default_serving_objectives(),
+                           registry=engine.metrics.registry,
+                           clock=lambda: clock[0], interval=slo_interval)
+        # a plausible tick-duration stream (baseline noise + one cliff)
+        durs = [1e-3 + (i % 7) * 1e-5 for i in range(ticks)]
+        t0 = time.perf_counter()
+        for i in range(ticks):
+            clock[0] = float(i)
+            snt.heartbeat(tick=i, busy=True)
+            snt.observe_tick(durs[i])
+            snt.check()
+            slo.tick()
+        best = min(best, time.perf_counter() - t0)
+    return best / ticks
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None,
+                    help="artifact path (default: <repo>/BENCH_slo.json)")
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--requests", type=int, default=96)
+    args = ap.parse_args(argv)
+
+    engine, driver, trace = _setup(seed=100, n_requests=args.requests)
+    base_samples = [_baseline_leg(engine, driver, trace)
+                    for _ in range(max(args.repeats, 3))]
+    baseline = min(base_samples)
+    ticks = max(engine.tick_count, 256)
+    cost = _ops_plane_cost(engine, ticks, args.repeats)
+    ratio = 1.0 + cost / baseline
+    passed = ratio <= 1.02
+    headline = (f"ops plane (sentinel+SLO, endpoints off): "
+                f"{ratio:.4f}x per serving tick")
+    print(f"[slo-bench] baseline {baseline * 1e3:.3f} ms/tick, ops-plane "
+          f"cost {cost * 1e6:.2f} us/tick -> {headline} "
+          f"({'PASS' if passed else 'FAIL'})")
+
+    artifact = {
+        "bench": "live ops plane serve overhead (sentinel + SLO burn-rate "
+                 "evaluation per tick, endpoints off, CPU)",
+        "headline": headline,
+        "overhead": {"serve": ratio},
+        "serve": {
+            "baseline_s_per_tick": baseline,
+            "baseline_samples": base_samples,
+            "ops_plane_s_per_tick": cost,
+            "overhead_ratio": ratio,
+            "ticks_measured": ticks,
+            "config": "latency_window=256, SLOEvaluator(interval=4)",
+        },
+        "repeats": args.repeats,
+        "acceptance": {"required": REQUIRED, "passed": passed},
+    }
+    out = args.json or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_slo.json",
+    )
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    print(f"[slo-bench] wrote {out}")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,19 @@ def _scaling_column(data) -> str:
     return "scaling " + ", ".join(parts)
 
 
+def _overhead_column(data) -> str:
+    """Render an ``overhead`` dict ({path: ratio}, BENCH_slo.json's ops
+    plane block) as e.g. ``overhead serve 1.02x``."""
+    overhead = data.get("overhead")
+    if not isinstance(overhead, dict) or not overhead:
+        return ""
+    try:
+        parts = [f"{k} {float(v):.3f}x" for k, v in sorted(overhead.items())]
+    except (TypeError, ValueError):
+        return ""
+    return "overhead " + ", ".join(parts)
+
+
 def collect(bench_dir: str):
     """One record per BENCH_*.json: name, headline, acceptance (or None).
     MULTICHIP_r*.json dryrun artifacts ride along: ok -> PASS, skipped ->
@@ -72,6 +85,7 @@ def collect(bench_dir: str):
             # BENCH_obs.json's measured overhead ratios)
             "headline": data.get("headline"),
             "scaling": _scaling_column(data) or None,
+            "overhead": _overhead_column(data) or None,
             "acceptance": acceptance,
             "passed": None if acceptance is None
             else bool(acceptance.get("passed")),
@@ -134,6 +148,8 @@ def main(argv=None) -> int:
                 detail += f" — {r['headline']}"
             if r.get("scaling"):
                 detail += f" — {r['scaling']}"
+            if r.get("overhead"):
+                detail += f" — {r['overhead']}"
             if required != "":
                 detail += f" [{required}]"
             if not r["passed"]:

@@ -12,6 +12,14 @@ burst through its own faults. Asserts the end state is healthy:
 - the loss-scale series halved and regrew through the storm;
 - every serving request completed with greedy parity vs solo decode.
 
+The ops-plane phase closes the detect→remediate loop: every injected
+fault class raises its MATCHING alert (tick crash → ``engine_fault``,
+slow ticks → ``latency_cliff``, overflow storm → ``scale_storm``), the
+sentinel's remediation fires through the existing recover/requeue/drain
+contract (a latency cliff recovers + requeues with token parity intact; a
+scale storm drains the training job through ``DrainConsensus``), and a
+seeded simulation's SLO alert stream is byte-identical across two runs.
+
 Everything is deterministic under the seed (same seed, same chaos, same
 trajectory). Writes ``BENCH_chaos.json`` with an acceptance block that
 ``tools/bench_trend.py`` aggregates, and exits 0 on PASS — wired as the
@@ -267,6 +275,215 @@ def _serve_chaos(seed: int, log):
             "faults_fired": list(injector.fired)}
 
 
+def _ops_chaos(seed: int, log):
+    """The live-ops-plane gate: every injected fault class raises its
+    MATCHING alert, sentinel remediation fires through the existing
+    recover/requeue/drain contract, the post-remediation stream stays
+    token-parity clean, and seeded simulation alert streams are
+    byte-identical across two runs."""
+    import jax
+    import numpy as np
+
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.obs import sentinel as obs_sentinel
+    from gradaccum_tpu.obs import trace as obs_trace
+    from gradaccum_tpu.obs.sentinel import Sentinel
+    from gradaccum_tpu.obs.slo import Objective, SLOEvaluator
+    from gradaccum_tpu.resilience import faults, remediation
+    from gradaccum_tpu.resilience.faults import (
+        FaultInjector,
+        FaultSchedule,
+        FaultSpec,
+    )
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    detail = {}
+    rng = np.random.default_rng(seed + 3)
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(jax.random.PRNGKey(0),
+                         {"input_ids": np.zeros((1, 8), np.int32)})
+
+    # -- leg A: serve — crash -> engine_fault, slow ticks -> latency_cliff
+    # whose remediation routes through recover + requeue, parity clean
+    engine = Engine(params, cfg, num_slots=2, max_len=64)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=(int(rng.integers(2, 8)),)).astype(np.int32)
+               for _ in range(4)]
+    # warm every program (prefill buckets at batch 1+2, the decode tick)
+    # OUTSIDE the watched window, so compile spikes never feed baselines
+    for p in prompts[:2]:
+        engine.submit(p, 3)
+    engine.run_until_idle()
+    for rid in list(engine.results):
+        engine.pop_result(rid)
+    t0 = engine.tick_count
+    crash_at = t0 + 2
+    slow_at = t0 + 12  # >= cliff_warmup clean ticks after the recovery
+    specs = [
+        FaultSpec(faults.MID_DECODE_TICK, at=crash_at),
+        FaultSpec(faults.MID_DECODE_TICK, at=slow_at,
+                  kind=faults.KIND_SLOW_TICK, delay=1.0),
+        FaultSpec(faults.MID_DECODE_TICK, at=slow_at + 1,
+                  kind=faults.KIND_SLOW_TICK, delay=1.0),
+    ]
+    log(f"[chaos/ops] serve plan: tick crash@{crash_at}, "
+        f"slow ticks@{slow_at},{slow_at + 1}")
+    snt = Sentinel(cliff_warmup=6, cliff_consecutive=2, cliff_score=6.0)
+    server = ServingServer(engine, max_requeues=3, sentinel=snt)
+    remediation.bind_default_remediations(snt, server=server)
+    injector = FaultInjector(FaultSchedule(specs))
+    with faults.installed(injector):
+        server.start()
+        handles = [server.submit(p, 24) for p in prompts]
+        results = [h.result(timeout=180) for h in handles]
+        server.stop()
+    kinds_fired = {a.kind for a in snt.anomalies if a.state == "fire"}
+    assert obs_sentinel.ENGINE_FAULT in kinds_fired, \
+        f"tick crash raised no engine_fault anomaly ({kinds_fired})"
+    assert obs_sentinel.LATENCY_CLIFF in kinds_fired, \
+        f"slow ticks raised no latency_cliff anomaly ({kinds_fired})"
+    # the remediation went THROUGH the server's recover/requeue contract:
+    # on the shared timeline, sentinel/remediation precedes a serve/recover
+    events = obs_trace.get_tracer().snapshot()
+    seqs = {}
+    for ev in events:
+        name = ev["name"]
+        if name in ("sentinel/remediation", "serve/recover", "req/requeue"):
+            seqs.setdefault(name, []).append(ev["args"]["seq"])
+    assert seqs.get("sentinel/remediation"), "remediation never fired"
+    remediation_seq = min(seqs["sentinel/remediation"])
+    assert any(s > remediation_seq for s in seqs.get("serve/recover", [])), \
+        "no serve/recover after the sentinel remediation"
+    # post-remediation stream: token parity vs solo decode, per request
+    for prompt, (tokens, reason) in zip(prompts, results):
+        assert reason in ("eos", "length"), reason
+        want = np.asarray(generate_cached(params, cfg, prompt, 24))
+        np.testing.assert_array_equal(np.asarray(tokens),
+                                      want[0, prompt.size:])
+    detail["serve"] = {
+        "anomalies": [a.to_dict() for a in snt.anomalies],
+        "fault_to_alert": {"crash": "engine_fault",
+                           "slow_tick": "latency_cliff"},
+        "requeues": len(seqs.get("req/requeue", [])),
+    }
+    log(f"[chaos/ops] serve PASS: crash->engine_fault, "
+        f"slow_tick->latency_cliff, remediation->recover "
+        f"({len(seqs.get('req/requeue', []))} requeue(s)), parity clean")
+
+    # -- leg B: train — overflow storm -> scale_storm whose remediation
+    # requests a drain through the consensus contract (the SIGTERM path)
+    import jax.numpy as jnp
+
+    import gradaccum_tpu as gt
+    from gradaccum_tpu.estimator.config import RunConfig
+    from gradaccum_tpu.estimator.estimator import Estimator, ModelBundle
+    from gradaccum_tpu.estimator.metrics import mean_absolute_error
+    from gradaccum_tpu.ops.loss_scale import LossScaleConfig
+    from gradaccum_tpu.resilience.preemption import DrainConsensus
+
+    K, n_steps = 4, 64
+    model = ModelBundle(
+        init=lambda prng, s: {"w": jnp.zeros((3, 1))},
+        loss=lambda p, b: jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2),
+        predict=lambda p, b: {"predictions": b["x"] @ p["w"]},
+        eval_metrics={"mae": mean_absolute_error(label_key="y")},
+    )
+    data_rng = np.random.default_rng(seed + 4)
+    data = [{"x": data_rng.normal(size=(8, 3)).astype(np.float32),
+             "y": data_rng.normal(size=(8, 1)).astype(np.float32)}
+            for _ in range(n_steps)]
+    storm = FaultSchedule.overflow_storm(
+        seed + 5, start_range=(16, 20), length_range=(3 * K, 4 * K)
+    ).specs[0]
+    log(f"[chaos/ops] train plan: overflow storm@{storm.at}x{storm.span}")
+    train_snt = Sentinel(storm_halvings=2, storm_window=float(8 * K))
+    consensus = DrainConsensus(multiprocess=False)
+    remediation.bind_default_remediations(train_snt, consensus=consensus)
+    est = Estimator(
+        model, gt.ops.sgd(0.05),
+        gt.GradAccumConfig(num_micro_batches=K, first_step_quirk=False,
+                           skip_nonfinite=True,
+                           loss_scale=LossScaleConfig(init_scale=16.0,
+                                                      growth_interval=1)),
+        RunConfig(model_dir=None, log_step_count_steps=1,
+                  drain_consensus=consensus, sentinel=train_snt),
+        mode="streaming",
+    )
+    with faults.installed(FaultInjector(FaultSchedule([storm]))):
+        state = est.train(data, max_steps=n_steps)
+    storm_fires = [a for a in train_snt.anomalies
+                   if a.kind == obs_sentinel.SCALE_STORM
+                   and a.state == "fire"]
+    assert storm_fires, "the overflow storm raised no scale_storm anomaly"
+    assert est.drained_at_step is not None, \
+        "the scale_storm remediation never drained through the consensus"
+    final_step = int(jax.device_get(state.step))
+    assert final_step == est.drained_at_step < n_steps
+    detail["train"] = {
+        "fault_to_alert": {"overflow_storm": "scale_storm"},
+        "storm_at": [storm.at, storm.span],
+        "drained_at_step": est.drained_at_step,
+    }
+    log(f"[chaos/ops] train PASS: overflow_storm->scale_storm, "
+        f"remediation->drain consensus (stopped at "
+        f"step={est.drained_at_step}/{n_steps})")
+
+    # -- leg C: seeded simulation alert streams are byte-identical
+    from gradaccum_tpu.serving import SimulationDriver
+    from gradaccum_tpu.serving.scheduler import QueueFull, Scheduler
+
+    def sim_alert_streams():
+        eng = Engine(params, cfg, num_slots=2, max_len=32,
+                     tracer=obs_trace.NullTracer(),
+                     scheduler=Scheduler(max_queue=2))
+        driver = SimulationDriver(eng, seed=seed + 6)
+        trace = driver.make_trace(24, arrival_rate=0.9, prompt_len=(1, 6),
+                                  max_new=(4, 10))
+        clock = lambda: float(eng.tick_count)
+        slo = SLOEvaluator(
+            [Objective("sim/queue_wait_p99", "serving/queue_wait",
+                       threshold=2.0, target=0.5,
+                       windows=((16.0, 1.0), (8.0, 1.0))),
+             Objective("sim/rejected_rate", "serving/rejected_total",
+                       threshold=0.2, target=0.5,
+                       windows=((16.0, 1.0), (8.0, 1.0)))],
+            registry=eng.metrics.registry, clock=clock,
+            tracer=obs_trace.NULL,
+        )
+        sim_snt = Sentinel(clock=clock, tracer=obs_trace.NULL, lease=8.0)
+        pending = sorted(enumerate(trace), key=lambda it: it[1].arrival_tick)
+        while pending or not eng.idle:
+            still = []
+            for idx, item in pending:
+                if item.arrival_tick > eng.tick_count:
+                    still.append((idx, item))
+                    continue
+                try:
+                    eng.submit(item.prompt, item.max_new_tokens,
+                               rng_seed=item.rng_seed)
+                except QueueFull:
+                    still.append((idx, item))
+            pending = still
+            eng.step()
+            sim_snt.heartbeat(tick=eng.tick_count, busy=not eng.idle)
+            sim_snt.check()
+            slo.tick()
+        return slo.alerts_bytes(), sim_snt.anomalies_bytes(), len(slo.alerts)
+
+    a1, s1, n_alerts = sim_alert_streams()
+    a2, s2, _ = sim_alert_streams()
+    assert n_alerts > 0, "the overload sim never fired an alert"
+    assert a1 == a2, "seeded sim SLO alert streams differ between runs"
+    assert s1 == s2, "seeded sim anomaly logs differ between runs"
+    detail["sim_determinism"] = {"alerts": n_alerts,
+                                 "byte_identical": True}
+    log(f"[chaos/ops] sim PASS: {n_alerts} alert transition(s), "
+        f"byte-identical across two seeded runs")
+    return detail
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seed", type=int, default=0xC8A05)
@@ -280,7 +497,13 @@ def main(argv=None) -> int:
     required = ("seeded chaos (train kill+storm+ckpt IO, serve tick "
                 "crash+slow tick): clean resume, non-empty final "
                 "checkpoint, greedy serving parity, every injected fault "
-                "in a flight-recorder dump with downstream activity")
+                "in a flight-recorder dump with downstream activity; ops "
+                "plane: each fault class raises its matching alert "
+                "(crash->engine_fault, slow_tick->latency_cliff, "
+                "overflow_storm->scale_storm), sentinel remediation fires "
+                "through the recover/requeue/drain contract with the "
+                "post-remediation stream token-parity clean, and seeded "
+                "simulation alert streams are byte-identical")
     passed = False
     detail = {}
     from gradaccum_tpu.obs.trace import Tracer
@@ -294,6 +517,7 @@ def main(argv=None) -> int:
             with tempfile.TemporaryDirectory() as work:
                 detail["train"] = _train_chaos(args.seed, work, log)
             detail["serve"] = _serve_chaos(args.seed, log)
+            detail["ops"] = _ops_chaos(args.seed, log)
         passed = True
     except AssertionError as e:
         log(f"[chaos] FAIL: {e}")
