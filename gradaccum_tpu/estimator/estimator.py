@@ -161,11 +161,20 @@ class Estimator:
         ``accum.first_step_quirk=False``: the quirk is a streaming-mode
         semantic the scan-based pipeline schedule cannot honor.
 
-        ``zero1``: shard the optimizer moments over the mesh's ``data``
-        axis (:mod:`parallel.zero` — per-device optimizer memory drops by
-        the data width; params stay replicated/rule-sharded, with the step
-        jitted under pinned in/out shardings so XLA cannot silently
-        propagate the split into parameter storage).
+        ``zero1``: shard the optimizer state (moments AND master weights)
+        over the mesh's ``data`` axis (:mod:`parallel.zero` — per-device
+        optimizer memory drops by the data width; params stay
+        replicated/rule-sharded). ``True`` pins the GSPMD placement (in/out
+        shardings, so XLA cannot silently propagate the split into
+        parameter storage; composes with ``sharding_rules``, ``fused_adam``
+        and ``sparse_embed``); ``"collective"`` opts into the explicit
+        shard_map path (``make_zero1_train_step``: psum'd window gradient →
+        sharded update → all-gather of updated params — same training
+        quality, but dropout masks are drawn per data shard, so it is not
+        bitwise-interchangeable with the single-program paths under
+        dropout); a ``seq`` mesh axis composes either way via
+        ``make_dp_sp_train_step(zero1=True)``. Checkpoints gather to the
+        full tree in all cases, so crash-resume stays bitwise.
 
         ``sparse_embed``: accumulate the embedding table's gradient as
         token-level rows instead of a dense [vocab, hidden] array per
@@ -209,12 +218,30 @@ class Estimator:
                     "acknowledge the schedule starts at a full K-cycle"
                 )
         if zero1:
+            if zero1 not in (True, "collective"):
+                raise ValueError(
+                    f"zero1 must be True (GSPMD placement) or 'collective' "
+                    f"(explicit shard_map path), got {zero1!r}"
+                )
             if axes.get(DATA_AXIS, 1) < 2:
                 raise ValueError("zero1 requires a mesh with a 'data' axis")
-            if self._sp_active or pipeline is not None:
+            if pipeline is not None:
                 raise ValueError(
-                    "zero1 runs on the GSPMD path (no 'seq' axis / pipeline)"
+                    "zero1 does not compose with pipeline (stage-sharded "
+                    "optimizer state is already partitioned over 'pipe')"
                 )
+            if zero1 == "collective" and not self._sp_active:
+                if sharding_rules is not None:
+                    raise ValueError(
+                        "zero1='collective' runs on shard_map and cannot "
+                        "compose with sharding_rules; use zero1=True (GSPMD "
+                        "placement)"
+                    )
+                if accum.fused_adam or sparse_embed:
+                    raise ValueError(
+                        "zero1='collective' cannot compose with fused_adam "
+                        "or sparse_embed; use zero1=True (GSPMD placement)"
+                    )
         if sparse_embed:
             if mode != "scan":
                 raise ValueError("sparse_embed requires mode='scan'")
@@ -228,18 +255,43 @@ class Estimator:
                     "sparse_embed composes with the scan/DP/GSPMD paths, "
                     "not 'seq' axis or pipeline"
                 )
-        # the guarded accumulator runs on EVERY training path (no-mesh, DP,
-        # GSPMD, seq-axis, pipeline, sparse_embed) — only dynamic loss
-        # scaling is out of scope for the pipeline step, whose PPState
-        # carries no DynamicLossScale
+        # the guarded accumulator AND dynamic loss scaling run on EVERY
+        # training path (no-mesh, DP, GSPMD, seq-axis, pipeline,
+        # sparse_embed) — PPState carries its own DynamicLossScale
         acc.validate_config(accum)
-        if accum.loss_scale is not None and pipeline is not None:
-            raise ValueError(
-                "dynamic loss scaling is not implemented for the pipeline "
-                "step (PPState carries no DynamicLossScale); the guard "
-                "itself (skip_nonfinite / normalize_by_good_count) works "
-                "under pipeline"
-            )
+        if accum.fused_adam:
+            # fused accumulation folds micro-batch grads into the moments;
+            # paths that accumulate per-replica and sync once per window
+            # (explicit shard_map collectives) cannot express that
+            if pipeline is not None:
+                raise ValueError(
+                    "fused_adam is not implemented for the pipeline step "
+                    "(stage gradients assemble once per window, there is "
+                    "no accumulation loop to fuse into)"
+                )
+            if self._sp_active:
+                raise ValueError(
+                    "fused_adam does not compose with the 'seq'-axis "
+                    "shard_map path (it would need a collective per "
+                    "micro-batch); drop fused_adam or the seq axis"
+                )
+            if sparse_embed:
+                raise ValueError(
+                    "fused_adam and sparse_embed both replace the "
+                    "accumulator; pick one"
+                )
+            if mesh is not None and sharding_rules is None and not zero1:
+                raise ValueError(
+                    "fused_adam on a mesh needs the GSPMD path (per-micro-"
+                    "batch global-mean gradients): pass sharding_rules=() "
+                    "or zero1=True instead of the explicit-collective DP "
+                    "path"
+                )
+            if getattr(optimizer, "fused", None) is None:
+                raise ValueError(
+                    "fused_adam requires an optimizer exposing FusedAccum "
+                    "hooks (ops.adamw.adamw / ops.adamw.adam)"
+                )
         self.model = model
         self.optimizer = optimizer
         self.accum = accum
@@ -363,12 +415,14 @@ class Estimator:
                 params, self.pipeline.n_stages
             )
             return pp_init(stages, self.optimizer,
-                           pre_params=pre, post_params=post)
+                           pre_params=pre, post_params=post,
+                           loss_scale=self.accum.loss_scale)
         if self.mode == "scan":
             return acc.scan_init(params, self.optimizer,
                                  loss_scale=self.accum.loss_scale)
         return acc.streaming_init(params, self.optimizer,
-                                  loss_scale=self.accum.loss_scale)
+                                  loss_scale=self.accum.loss_scale,
+                                  fused=self.accum.fused_adam)
 
     def _maybe_restore(self, template):
         self._ckpt_sync()
@@ -417,6 +471,7 @@ class Estimator:
                 clip_norm=self.accum.clip_norm,
                 skip_nonfinite=self.accum.skip_nonfinite,
                 normalize_by_good_count=self.accum.normalize_by_good_count,
+                loss_scale=self.accum.loss_scale,
             )
         elif self._sp_active:
             from gradaccum_tpu.parallel.sp import make_dp_sp_train_step
@@ -426,7 +481,20 @@ class Estimator:
                 sp_kwargs["seq_keys"] = tuple(self.model.seq_keys)
             step = make_dp_sp_train_step(
                 loss_fn, self.optimizer, self.accum, self.mesh,
-                needs_rng=needs_rng, **sp_kwargs,
+                needs_rng=needs_rng, zero1=self.zero1, **sp_kwargs,
+            )
+        elif self.zero1 == "collective":
+            # explicit-collective ZeRO-1 (opt-in): local grad accumulation
+            # -> one psum per window -> sharded update -> all-gather of the
+            # updated params. zero1=True keeps the GSPMD placement below —
+            # the two paths train equally but are not bitwise-identical
+            # under dropout (each data shard draws its mask from the
+            # replicated key over its own rows).
+            from gradaccum_tpu.parallel.zero import make_zero1_train_step
+
+            step = make_zero1_train_step(
+                loss_fn, self.optimizer, self.accum, self.mesh,
+                mode=self.mode, needs_rng=needs_rng,
             )
         elif self.mesh is not None and self.sharding_rules is None and not self.zero1:
             inner_builder = None

@@ -34,6 +34,7 @@ from flax import linen as nn
 
 from gradaccum_tpu.estimator.estimator import ModelBundle
 from gradaccum_tpu.estimator.metrics import accuracy
+from gradaccum_tpu.utils.tree import tree_cast_floating
 
 
 @dataclasses.dataclass(frozen=True)
@@ -282,6 +283,7 @@ def bert_classifier_bundle(
     num_classes: int = 2,
     attention_fn: Callable = dense_attention,
     seq_axis: Optional[str] = None,
+    compute_dtype: Any = None,
 ) -> ModelBundle:
     """ModelBundle for CoLA/Yelp-style sequence classification.
 
@@ -294,6 +296,11 @@ def bert_classifier_bundle(
     tree is identical, so initialization never needs the mesh). Dropout is
     rejected in sp mode: a replicated rng would draw block-periodic masks,
     and per-rank keys would break the head's seq-invariance.
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``): store the params in
+    ``compute_dtype`` and run the encoder in it (the classifier head and
+    loss stay f32); pair with ``adamw(..., master_dtype=jnp.float32)`` so
+    the f32 master weights live in the optimizer state.
     """
     if seq_axis is not None and (
         config.hidden_dropout > 0 or config.attention_dropout > 0
@@ -302,6 +309,8 @@ def bert_classifier_bundle(
             "sequence-parallel BERT requires hidden_dropout=0 and "
             "attention_dropout=0 (standard for long-context training)"
         )
+    if compute_dtype is not None:
+        config = dataclasses.replace(config, dtype=compute_dtype)
     model = BertClassifier(config, num_classes, attention_fn, seq_axis)
     # dense twin for init: same params, no axis binding required
     init_model = (
@@ -318,7 +327,8 @@ def bert_classifier_bundle(
         )
         # keep only trainables: MoE layers also sow a "losses" collection at
         # init, which must not leak into the optimizer state
-        return {"params": variables["params"]}
+        return tree_cast_floating({"params": variables["params"]},
+                                  compute_dtype)
 
     moe = config.num_experts > 0
 

@@ -26,6 +26,7 @@ from flax import linen as nn
 from gradaccum_tpu.estimator.estimator import ModelBundle
 from gradaccum_tpu.estimator.metrics import Metric
 from gradaccum_tpu.models.bert import SelfAttention, dense_attention
+from gradaccum_tpu.utils.tree import tree_cast_floating
 
 
 @dataclasses.dataclass(frozen=True)
@@ -180,17 +181,27 @@ def token_accuracy() -> Metric:
 def gpt_lm_bundle(
     config: GPTConfig,
     attention_fn: Callable = dense_attention,
+    compute_dtype: Any = None,
 ) -> ModelBundle:
     """ModelBundle for causal-LM training: batches ``{"input_ids": [B, S]
     int32}`` (+ optional ``"loss_mask"`` [B, S]); harness injects ``"rng"``
-    for dropout."""
+    for dropout.
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``): mixed-precision training —
+    the params are STORED in ``compute_dtype`` (so forward/backward and the
+    weight-tied embedding run low-precision end to end; logits/loss stay
+    f32) and the optimizer should carry the f32 masters:
+    ``adamw(..., master_dtype=jnp.float32)``."""
+    if compute_dtype is not None:
+        config = dataclasses.replace(config, dtype=compute_dtype)
     model = GPTLM(config, attention_fn)
 
     def init(rng, sample):
         variables = model.init(
             {"params": rng, "dropout": rng}, sample["input_ids"], True
         )
-        return {"params": variables["params"]}
+        return tree_cast_floating({"params": variables["params"]},
+                                  compute_dtype)
 
     def loss(params, batch):
         logits = model.apply(

@@ -19,6 +19,7 @@ from gradaccum_tpu.estimator.metrics import (
     mean_absolute_error,
     root_mean_squared_error,
 )
+from gradaccum_tpu.utils.tree import tree_cast_floating
 
 
 class HousingMLP(nn.Module):
@@ -33,12 +34,24 @@ class HousingMLP(nn.Module):
         return nn.Dense(1, dtype=self.dtype, name="output")(x).astype(jnp.float32)
 
 
-def housing_mlp_bundle(hidden: Sequence[int] = (16, 8, 4)) -> ModelBundle:
-    """Batches: ``{"x": [B, 14] float32, "y": [B, 1] float32}``."""
-    model = HousingMLP(hidden=tuple(hidden))
+def housing_mlp_bundle(
+    hidden: Sequence[int] = (16, 8, 4), compute_dtype: Any = None
+) -> ModelBundle:
+    """Batches: ``{"x": [B, 14] float32, "y": [B, 1] float32}``.
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``): store the params in
+    ``compute_dtype`` and run the stack in it (the head re-casts to f32,
+    so the MSE loss stays full precision); pair with
+    ``adam(..., master_dtype=jnp.float32)``.
+    """
+    model = HousingMLP(
+        hidden=tuple(hidden),
+        dtype=jnp.float32 if compute_dtype is None else compute_dtype,
+    )
 
     def init(rng, sample):
-        return model.init(rng, sample["x"])
+        return tree_cast_floating(model.init(rng, sample["x"]),
+                                  compute_dtype)
 
     def loss(params, batch):
         pred = model.apply(params, batch["x"])

@@ -17,6 +17,7 @@ from flax import linen as nn
 
 from gradaccum_tpu.estimator.estimator import ModelBundle
 from gradaccum_tpu.estimator.metrics import accuracy
+from gradaccum_tpu.utils.tree import tree_cast_floating
 
 
 class MnistCNN(nn.Module):
@@ -42,15 +43,28 @@ def sparse_softmax_loss(logits, labels):
     return jnp.sum(per_example) * (1.0 / labels.shape[0])
 
 
-def mnist_cnn_bundle(dtype=jnp.float32) -> ModelBundle:
+def mnist_cnn_bundle(dtype=jnp.float32, compute_dtype=None) -> ModelBundle:
     """ModelBundle for the MNIST model_fn (01:20-65).
 
     Batches: ``{"image": [B,28,28,1] float32, "label": [B] int}``.
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``): store the params in
+    ``compute_dtype`` and run conv/dense in it (logits/loss stay f32);
+    pair with ``adam(..., master_dtype=jnp.float32)``. Mutually exclusive
+    with the older ``dtype`` knob (compute-only, f32 param storage).
     """
+    if compute_dtype is not None:
+        if dtype != jnp.float32:
+            raise ValueError(
+                "pass either dtype (compute-only) or compute_dtype (params "
+                "stored low-precision too), not both"
+            )
+        dtype = compute_dtype
     model = MnistCNN(dtype=dtype)
 
     def init(rng, sample):
-        return model.init(rng, sample["image"])
+        return tree_cast_floating(model.init(rng, sample["image"]),
+                                  compute_dtype)
 
     def loss(params, batch):
         logits = model.apply(params, batch["image"])

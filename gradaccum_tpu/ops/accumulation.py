@@ -108,6 +108,24 @@ class GradAccumConfig(NamedTuple):
     # rides in ScanState/StreamingState.loss_scale (checkpointed).
     # Requires skip_nonfinite.
     loss_scale: Optional[LossScaleConfig] = None
+    # Fused Adam-accumulation (AdamA, arXiv 2305.19982): fold each
+    # micro-batch's gradient straight into the optimizer's m/v moments —
+    # the per-variable f32 gradient ACCUMULATOR disappears, cutting the
+    # accumulation window's optimizer+accumulator footprint from three
+    # f32 trees (m, v, grad sum) to two. Requires an optimizer exposing
+    # FusedAccum hooks (ops.adamw.adamw / adam). Numerics: the first
+    # moment is the two-pass value up to fp association; the second
+    # moment accumulates the MEAN OF SQUARES of the micro-batch gradients
+    # where two-pass Adam squares the mean (identical at K=1) — AdamA's
+    # documented deviation, convergence-equivalent at matched tolerance.
+    # Composes with skip_nonfinite / loss_scale (the unscale folds into
+    # the per-micro-batch fold factor); incompatible with clip_norm (no
+    # materialized gradient sum to clip), normalize_by_good_count (the
+    # denominator is folded per micro-batch, before the good count is
+    # known), and the explicit shard_map DP path (axis_name — folding
+    # local grads into replicated moments would need a per-micro-batch
+    # collective; run fused on the GSPMD path instead).
+    fused_adam: bool = False
     # Mesh axes that partition ONE example (e.g. 'seq': token shards of the
     # same sequence). Two consequences the step must honor: (a) the
     # per-micro-batch gradient is the SUM of the shards' contributions —
@@ -155,6 +173,44 @@ def _zero_if_bad(grads, good):
     )
 
 
+def _accum_zeros(tree):
+    """Zeroed gradient accumulators at f32-or-wider — the paper's one f32
+    accumulator per trainable variable, regardless of the params' compute
+    dtype: bf16 micro-batch gradients accumulate in f32 so a K-window never
+    rounds away low-order contributions. Bitwise no-op for f32 params."""
+    return jax.tree.map(
+        lambda p: jnp.zeros(p.shape, jnp.promote_types(p.dtype, jnp.float32)),
+        tree,
+    )
+
+
+def _accum_add(accum, grads):
+    """``accum += grads`` with low-precision grads upcast into the f32
+    accumulator (identity for f32-on-f32)."""
+    return jax.tree.map(lambda a, g: a + g.astype(a.dtype), accum, grads)
+
+
+def _fused_inv_factors(k: int, scale):
+    """Per-micro-batch fold factors for fused accumulation: ``inv_m`` folds
+    the 1/K window normalization and the loss unscale into the first-moment
+    add; ``inv_v`` folds their squares (the second moment accumulates
+    squared gradients)."""
+    if scale is None:
+        inv = jnp.float32(1.0 / k)
+        return inv, inv
+    inv_m = 1.0 / (k * scale)
+    return inv_m, 1.0 / (k * scale * scale)
+
+
+def _require_fused_hooks(optimizer: Optimizer):
+    if optimizer.fused is None:
+        raise ValueError(
+            "GradAccumConfig.fused_adam requires an optimizer exposing "
+            "FusedAccum hooks (ops.adamw.adamw / ops.adamw.adam); "
+            f"{optimizer} has none"
+        )
+
+
 def validate_config(config: "GradAccumConfig") -> None:
     """Reject knob combinations the guard cannot honor (fail at build time,
     not as silently-wrong numerics inside a compiled step)."""
@@ -168,6 +224,26 @@ def validate_config(config: "GradAccumConfig") -> None:
             "dynamic loss scaling detects overflow through the non-finite "
             "guard; it requires skip_nonfinite=True"
         )
+    if config.fused_adam:
+        if config.clip_norm is not None:
+            raise ValueError(
+                "fused_adam never materializes the accumulated gradient, so "
+                "there is nothing for clip_norm to clip; disable one of them"
+            )
+        if config.normalize_by_good_count:
+            raise ValueError(
+                "fused_adam folds the 1/K normalization into each "
+                "micro-batch before the window's good count is known; "
+                "normalize_by_good_count cannot compose with it"
+            )
+        if config.axis_name is not None:
+            raise ValueError(
+                "fused_adam folds micro-batch gradients straight into the "
+                "replicated optimizer moments; under the explicit shard_map "
+                "DP path (axis_name) that would need a collective per "
+                "micro-batch. Run fused accumulation on the GSPMD path "
+                "(sharding_rules / zero1) instead"
+            )
 
 
 def _agree(good, axes: Tuple[str, ...]):
@@ -253,7 +329,8 @@ def accumulate_scan(
     The returned ``train_step(state, super_batch)`` expects every leaf of
     ``super_batch`` stacked to ``[K, micro_batch, ...]`` and returns
     ``(new_state, aux)`` with ``aux = {"loss": mean-over-K, "grad_norm": ...,
-    "lr_step": ...}``. ``state.step`` advances by K (micro-batch counting,
+    "lr_step": ...}`` — except under ``fused_adam``, where no gradient sum
+    ever materializes and ``aux`` carries no ``"grad_norm"``. ``state.step`` advances by K (micro-batch counting,
     optimization.py:102-103), and the optimizer/schedule sees the counter at
     the *end* of the cycle — the same step value at which the reference's
     steady-state apply branch fires (it applies at ``global_step == m*K``,
@@ -272,6 +349,9 @@ def accumulate_scan(
         _make_scaled_grad_fn(loss_fn) if config.loss_scale is not None else None
     )
     axis = config.axis_name
+    fused = config.fused_adam
+    if fused:
+        _require_fused_hooks(optimizer)
 
     def train_step(state: ScanState, super_batch, rng=None):
         leading = {x.shape[0] for x in jax.tree.leaves(super_batch)}
@@ -302,6 +382,8 @@ def accumulate_scan(
             xs = (super_batch, None)
 
         skip = config.skip_nonfinite
+        if fused:
+            inv_m, inv_v = _fused_inv_factors(k, scale)
 
         def body(carry, x):
             accum, n_good = carry
@@ -314,6 +396,7 @@ def accumulate_scan(
             # example axes (seq shards): the micro-batch gradient is the
             # shards' SUM — auto-inserted by VMA, explicit on old jax
             grads = compat.psum_unsynced(grads, config.example_axes)
+            good = None
             if skip:
                 good = _all_finite(check_loss, grads)
                 # axes that partition ONE example (seq shards) must
@@ -321,48 +404,89 @@ def accumulate_scan(
                 good = _agree(good, config.example_axes)
                 grads = _zero_if_bad(grads, good)
                 loss = jnp.where(good, loss, 0.0)  # masked out of the mean
+            if fused:
+                # fold this micro-batch into m/v; the first USABLE
+                # micro-batch of the window carries the β-decay, so an
+                # all-bad window leaves the moments bitwise untouched
+                first = (
+                    n_good == 0 if good is None
+                    else jnp.logical_and(n_good == 0, good)
+                )
+                accum = optimizer.fused.accumulate(
+                    accum, grads, good, first, inv_m, inv_v
+                )
+            else:
+                accum = _accum_add(accum, grads)
+            if skip:
                 n_good = n_good + good.astype(jnp.int32)
-            accum = jax.tree.map(jnp.add, accum, grads)
+            elif fused:
+                n_good = n_good + 1  # window position drives `first`
             return (accum, n_good), loss
 
-        carry0 = (tree_zeros_like(diff_params), jnp.zeros((), jnp.int32))
+        carry0 = (
+            optimizer.fused.moments(state.opt_state) if fused
+            else _accum_zeros(diff_params),
+            jnp.zeros((), jnp.int32),
+        )
         (accum, n_good), losses = lax.scan(body, carry0, xs, length=k,
                                            unroll=config.unroll)
-        if axis is not None:
+        if axis is not None:  # fused forbids axis_name (validate_config)
             accum = lax.psum(accum, axis)  # the one collective per update
             total = k * compat.axis_size(axis)
             if skip:
                 n_good = lax.psum(n_good, axis)
         else:
             total = k
-        if skip and config.normalize_by_good_count:
-            # rescale over the survivors instead of shrinking the update
-            # (max(.,1) keeps the all-bad window finite; its apply is
-            # cond-skipped below anyway)
-            denom = jnp.maximum(n_good, 1).astype(jnp.float32)
-        else:
-            # denom stays K(*N): a skipped micro-batch contributes zero, so
-            # the update shrinks instead of rescaling
-            denom = total
-        if scale is not None:
-            denom = denom * scale  # unscale BEFORE clip/apply
-        grads, norm = _finalize(accum, config, denom)
         apply_step = state.step + k
-        if skip:
-            # an all-bad window must not apply at all (AdamW would still
-            # decay and advance moments on a zero gradient)
-            new_params, new_opt_state = lax.cond(
-                n_good > 0,
-                lambda _: optimizer.update(
-                    grads, state.opt_state, state.params, apply_step
-                ),
-                lambda _: (state.params, state.opt_state),
-                None,
-            )
+        norm = None
+        if fused:
+            # the moments already hold the normalized, unscaled window; the
+            # apply reads them — the all-bad cond only guards the PARAM
+            # update (the carried moments are bitwise the old ones then)
+            if skip:
+                new_params, new_opt_state = lax.cond(
+                    n_good > 0,
+                    lambda mv: optimizer.fused.apply(
+                        state.opt_state, mv, state.params, apply_step
+                    ),
+                    lambda mv: (
+                        state.params,
+                        optimizer.fused.carry_into(state.opt_state, mv),
+                    ),
+                    accum,
+                )
+            else:
+                new_params, new_opt_state = optimizer.fused.apply(
+                    state.opt_state, accum, state.params, apply_step
+                )
         else:
-            new_params, new_opt_state = optimizer.update(
-                grads, state.opt_state, state.params, apply_step
-            )
+            if skip and config.normalize_by_good_count:
+                # rescale over the survivors instead of shrinking the update
+                # (max(.,1) keeps the all-bad window finite; its apply is
+                # cond-skipped below anyway)
+                denom = jnp.maximum(n_good, 1).astype(jnp.float32)
+            else:
+                # denom stays K(*N): a skipped micro-batch contributes zero,
+                # so the update shrinks instead of rescaling
+                denom = total
+            if scale is not None:
+                denom = denom * scale  # unscale BEFORE clip/apply
+            grads, norm = _finalize(accum, config, denom)
+            if skip:
+                # an all-bad window must not apply at all (AdamW would still
+                # decay and advance moments on a zero gradient)
+                new_params, new_opt_state = lax.cond(
+                    n_good > 0,
+                    lambda _: optimizer.update(
+                        grads, state.opt_state, state.params, apply_step
+                    ),
+                    lambda _: (state.params, state.opt_state),
+                    None,
+                )
+            else:
+                new_params, new_opt_state = optimizer.update(
+                    grads, state.opt_state, state.params, apply_step
+                )
         if scale_cfg is not None:
             # scale self-adjusts at every window boundary, applied or not:
             # a dirty window halves, growth_interval clean ones regrow
@@ -391,7 +515,11 @@ def accumulate_scan(
             loss = jnp.mean(losses)
             if axis is not None:
                 loss = lax.pmean(loss, axis)
-        aux = {"loss": loss, "grad_norm": norm, "lr_step": apply_step}
+        aux = {"loss": loss, "lr_step": apply_step}
+        if norm is not None:
+            # fused mode never materializes the gradient sum, so there is
+            # no window gradient norm to report
+            aux["grad_norm"] = norm
         if skip:
             aux["skipped"] = jnp.int32(total) - n_good  # window-global count
             aux["good_count"] = n_good
@@ -435,11 +563,18 @@ class StreamingState(NamedTuple):
 def streaming_init(
     params, optimizer: Optimizer,
     loss_scale: Optional[LossScaleConfig] = None,
+    fused: bool = False,
 ) -> StreamingState:
+    """``fused=True`` (GradAccumConfig.fused_adam): the persistent gradient
+    accumulators are ELIMINATED — ``accum_grads`` becomes an empty pytree
+    (the optimizer's m/v moments carry the window instead), shrinking both
+    the live state and the checkpoint by one f32 tree per variable."""
     return StreamingState(
         params=params,
         opt_state=optimizer.init(params),
-        accum_grads=tree_zeros_like(params),
+        # f32-or-wider accumulators (see _accum_zeros): low-precision
+        # params keep a full-precision persistent accumulation window
+        accum_grads=() if fused else _accum_zeros(params),
         step=jnp.zeros((), dtype=jnp.int32),
         good_count=jnp.zeros((), dtype=jnp.int32),
         loss_scale=None if loss_scale is None else init_loss_scale(loss_scale),
@@ -464,6 +599,9 @@ def streaming_step(
     scaled_grad_fn = (
         _make_scaled_grad_fn(loss_fn) if config.loss_scale is not None else None
     )
+    fused = config.fused_adam
+    if fused:
+        _require_fused_hooks(optimizer)
     # Reference phase: apply when step % K == 0 (optimization.py:91) — includes
     # the step-0 quirk. Quirk-free phase applies once K grads have accumulated.
     phase = 0 if config.first_step_quirk else k - 1
@@ -505,6 +643,7 @@ def streaming_step(
             grads, ((axis,) if axis is not None else ()) + config.example_axes
         )
         skip = config.skip_nonfinite
+        good = None
         if skip:
             # a non-finite micro-batch contributes ZEROS to the persistent
             # accumulators — the window survives; denom stays K so the
@@ -530,12 +669,55 @@ def streaming_step(
             # the log marks the skipped micro-batch. (The scan path's
             # masking applies to window MEANS — at micro-batch granularity
             # a skipped batch has no usable loss to substitute.)
+        else:
+            # fused mode tracks the window position through good_count even
+            # unguarded (its `first` flag carries the β-decay)
+            good_inc = jnp.ones((), jnp.int32)
         n_replicas = compat.axis_size(axis) if axis is not None else 1
+
+        if fused:
+            # fold THIS micro-batch into m/v before the branch cond — both
+            # branches see the updated moments (the apply branch's
+            # re-accumulate-first semantic, optimization.py:81, for free)
+            inv_m, inv_v = _fused_inv_factors(k, scale)
+            first = state.good_count == 0
+            if skip:
+                first = jnp.logical_and(first, good)
+            mv = optimizer.fused.accumulate(
+                optimizer.fused.moments(state.opt_state),
+                grads, good, first, inv_m, inv_v,
+            )
 
         def apply_branch(operand):
             params, opt_state, accum, n_good, ls = operand
+            if fused:
+                window_good = n_good + good_inc if skip else None
+                sched_step = state.step + step_offset
+                if skip:
+                    new_params, new_opt_state = lax.cond(
+                        window_good > 0,
+                        lambda m2: optimizer.fused.apply(
+                            opt_state, m2, params, sched_step
+                        ),
+                        lambda m2: (
+                            params,
+                            optimizer.fused.carry_into(opt_state, m2),
+                        ),
+                        mv,
+                    )
+                else:
+                    new_params, new_opt_state = optimizer.fused.apply(
+                        opt_state, mv, params, sched_step
+                    )
+                if scale_cfg is not None:
+                    # window boundary: the scale self-adjusts whether or not
+                    # the apply ran (loss_scale requires skip_nonfinite, so
+                    # window_good is always defined here)
+                    ls = update_loss_scale(ls, scale_cfg, window_good >= k)
+                return (new_params, new_opt_state, accum,
+                        jnp.zeros((), jnp.int32), ls)
             # (a) re-accumulate the current grad first (optimization.py:81)
-            accum = jax.tree.map(jnp.add, accum, grads)
+            accum = _accum_add(accum, grads)
             window_good = n_good + good_inc if skip else None
             if skip and config.normalize_by_good_count:
                 # good_count counts window micro-batch CALLS (replica
@@ -578,7 +760,10 @@ def streaming_step(
 
         def accumulate_branch(operand):
             params, opt_state, accum, n_good, ls = operand
-            accum = jax.tree.map(jnp.add, accum, grads)
+            if fused:
+                return (params, optimizer.fused.carry_into(opt_state, mv),
+                        accum, n_good + good_inc, ls)
+            accum = _accum_add(accum, grads)
             if skip:
                 n_good = n_good + good_inc
             return params, opt_state, accum, n_good, ls

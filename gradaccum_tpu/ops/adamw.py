@@ -23,6 +23,22 @@ functions. ``update(grads, state, params, step)`` returns
 ``(new_params, new_state)``; ``step`` feeds the LR schedule and (for Adam)
 bias correction. Everything is jit-traceable; state is an ordinary pytree so
 it checkpoints and shards like any other TrainState leaf.
+
+Mixed precision (bf16 training): ``master_dtype`` keeps a full-precision
+MASTER copy of every parameter inside the optimizer state
+(:class:`MasterAdamState`) — the forward/backward runs on low-precision
+params, the update math runs on the f32 masters, and the working params are
+re-cast from the updated masters each step, so repeated tiny updates never
+round away in bf16. ``moment_dtype`` makes the m/v storage dtype explicit;
+the old silent ``grad.astype(m.dtype)`` is now a deliberate contract: casts
+that LOSE precision (an f32 gradient into bf16 moments) raise unless the
+caller opted in by passing ``moment_dtype`` explicitly.
+
+Fused accumulation (AdamA, arXiv 2305.19982): the optional
+:class:`FusedAccum` hooks on :class:`Optimizer` let the gradient-accumulation
+window fold each micro-batch's gradient straight into the Adam moments,
+eliminating the per-variable f32 gradient accumulator entirely — see
+``GradAccumConfig.fused_adam`` in :mod:`gradaccum_tpu.ops.accumulation`.
 """
 
 from __future__ import annotations
@@ -40,14 +56,70 @@ from gradaccum_tpu.utils.tree import tree_map_with_names, tree_zeros_like
 DEFAULT_WEIGHT_DECAY_EXCLUSIONS = ("LayerNorm", "layer_norm", "bias")
 
 
+class FusedAccum(NamedTuple):
+    """Optimizer-specific hooks for fused Adam-accumulation (AdamA,
+    arXiv 2305.19982). The accumulation window calls these instead of
+    materializing a gradient sum:
+
+    - ``moments(opt_state) -> (m, v)`` — the moment trees the window will
+      carry in place of the gradient accumulator.
+    - ``carry_into(opt_state, (m, v)) -> opt_state`` — plant updated
+      moments back without applying (streaming accumulate branch / the
+      all-bad-window no-op, where the carried moments are bitwise the old
+      ones by construction).
+    - ``accumulate((m, v), grads, good, first, inv_m, inv_v) -> (m, v)`` —
+      one micro-batch: on the FIRST usable micro-batch of the window the
+      β-decay of the old moments is applied in the same op (so an all-bad
+      window never touches them), then ``m += (1-β1)·g·inv_m`` and
+      ``v += (1-β2)·g²·inv_v``. ``inv_m = 1/(K·scale)`` folds the window
+      normalization and the loss unscale; ``inv_v`` folds their squares.
+      ``v`` therefore accumulates the MEAN OF SQUARES of the micro-batch
+      gradients where two-pass Adam uses the square of the mean — AdamA's
+      documented (and bounded: mean-of-squares ≥ square-of-mean) deviation;
+      identical at K=1. ``good=None`` means unguarded.
+    - ``apply(opt_state, (m, v), params, step) -> (params, opt_state)`` —
+      the window-boundary parameter update from the carried moments.
+    """
+
+    moments: Callable[[Any], tuple]
+    carry_into: Callable[[Any, tuple], Any]
+    accumulate: Callable[..., tuple]
+    apply: Callable[..., tuple]
+
+
 class Optimizer(NamedTuple):
     init: Callable[[Any], Any]
     update: Callable[..., Any]  # (grads, state, params, step) -> (params, state)
+    # optional FusedAccum hooks (None: the optimizer cannot run the fused
+    # accumulation window — e.g. sgd, or wrappers that cannot see Adam's
+    # internals)
+    fused: Any = None
 
 
 class AdamState(NamedTuple):
     m: Any
     v: Any
+
+
+class MasterAdamState(NamedTuple):
+    """AdamState plus the f32 (``master_dtype``) master copy of the params.
+    Module-level for pytree compatibility (see :class:`AdamBCState`).
+    Only built when ``master_dtype`` is set, so plain-precision checkpoints
+    keep the two-field :class:`AdamState` schema."""
+
+    m: Any
+    v: Any
+    master: Any
+
+
+class MasterAdamBCState(NamedTuple):
+    """Bias-corrected Adam state with master weights (see
+    :class:`MasterAdamState`)."""
+
+    t: jnp.ndarray
+    m: Any
+    v: Any
+    master: Any
 
 
 class AdamBCState(NamedTuple):
@@ -77,6 +149,93 @@ def _leafwise(arity: int, fn, params, *trees):
     )
 
 
+def _grad_caster(moment_dtype_explicit: bool):
+    """The deliberate replacement for the old silent ``grad.astype(m.dtype)``.
+
+    Same-dtype: no-op. Upcast (bf16 grad into f32 moments): always fine —
+    precision only grows. DOWNCAST (f32 grad into bf16 moments): silently
+    losing gradient precision is exactly the bug class this contract
+    removes, so it raises unless the caller opted in by passing
+    ``moment_dtype`` explicitly. Raised at trace time — the config error
+    surfaces at step build, never as quietly-degraded numerics."""
+
+    def cast(grad, moment_dtype):
+        moment_dtype = jnp.dtype(moment_dtype)
+        if grad.dtype == moment_dtype:
+            return grad
+        if (
+            not moment_dtype_explicit
+            and jnp.promote_types(grad.dtype, moment_dtype) != moment_dtype
+        ):
+            raise ValueError(
+                f"gradient dtype {grad.dtype} would be silently downcast to "
+                f"moment dtype {moment_dtype}; pass moment_dtype= (to accept "
+                "the precision loss) or master_dtype= (to keep f32 moments "
+                "and masters under low-precision params) to the optimizer"
+            )
+        return grad.astype(moment_dtype)
+
+    return cast
+
+
+def _master_init(params, master_dtype, moment_dtype):
+    """(m, v, master) trees for a master-weight optimizer: moments in
+    ``moment_dtype`` (default: ``master_dtype``), master = params upcast."""
+    mdt = jnp.dtype(moment_dtype if moment_dtype is not None else master_dtype)
+    zeros = lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, mdt), params)
+    master = jax.tree.map(lambda p: p.astype(master_dtype), params)
+    return zeros(), zeros(), master
+
+
+def _moment_init(params, moment_dtype):
+    if moment_dtype is None:
+        return tree_zeros_like(params), tree_zeros_like(params)
+    mdt = jnp.dtype(moment_dtype)
+    zeros = lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, mdt), params)
+    return zeros(), zeros()
+
+
+def _fused_moment_hooks(beta_1: float, beta_2: float, cast_grad):
+    """The (moments, carry_into, accumulate) FusedAccum hooks shared by
+    :func:`adamw` and :func:`adam` — the moment fold is identical math for
+    both; only ``apply`` differs (bias correction). One implementation so a
+    numerics fix can never silently diverge between the two optimizers."""
+
+    def moments(state):
+        return (state.m, state.v)
+
+    def carry_into(state, mv):
+        return state._replace(m=mv[0], v=mv[1])
+
+    def accumulate(mv, grads, good, first, inv_m, inv_v):
+        m_tree, v_tree = mv
+
+        def one(m, grad, v):
+            g = cast_grad(grad, m.dtype)
+            w1 = jnp.where(first, beta_1, 1.0).astype(m.dtype)
+            w2 = jnp.where(first, beta_2, 1.0).astype(v.dtype)
+            # the f32 inv factors promote the fold; cast back so the carry
+            # keeps the moment dtype (no-op for f32 moments — bitwise
+            # contract intact; explicit low-precision moment_dtype folds
+            # through f32 and re-rounds, same as its grad cast)
+            next_m = (m * w1 + (1.0 - beta_1) * (g * inv_m)).astype(m.dtype)
+            next_v = (v * w2 + (1.0 - beta_2) * (g * (g * inv_v))).astype(
+                v.dtype
+            )
+            if good is not None:
+                # select, not mask-to-zero: a skipped micro-batch must leave
+                # the moments BITWISE untouched (the all-bad-window no-op
+                # contract rides on it)
+                next_m = jnp.where(good, next_m, m)
+                next_v = jnp.where(good, next_v, v)
+            return next_m, next_v
+
+        new_m, new_v = _leafwise(2, one, m_tree, grads, v_tree)
+        return (new_m, new_v)
+
+    return moments, carry_into, accumulate
+
+
 def _decay_mask(params, exclusions: Sequence[str]):
     """Static per-leaf bool: apply weight decay? (optimization.py:179-187).
 
@@ -99,34 +258,88 @@ def adamw(
     beta_2: float = 0.999,
     epsilon: float = 1e-6,
     exclude_from_weight_decay: Optional[Sequence[str]] = DEFAULT_WEIGHT_DECAY_EXCLUSIONS,
+    master_dtype: Any = None,
+    moment_dtype: Any = None,
 ) -> Optimizer:
-    """AdamW exactly per optimization.py:107-194 (no bias correction)."""
+    """AdamW exactly per optimization.py:107-194 (no bias correction).
+
+    ``master_dtype`` (e.g. ``jnp.float32`` under bf16 params): keep master
+    weights in the optimizer state and re-cast the working params from them
+    each step. ``moment_dtype``: explicit m/v storage dtype (default: the
+    param dtype, or ``master_dtype`` when set) — see module docstring for
+    the cast contract.
+    """
     schedule = as_schedule(learning_rate)
     exclusions = tuple(exclude_from_weight_decay or ())
+    cast_grad = _grad_caster(moment_dtype is not None)
 
     def init(params):
-        return AdamState(m=tree_zeros_like(params), v=tree_zeros_like(params))
+        if master_dtype is not None:
+            m, v, master = _master_init(params, master_dtype, moment_dtype)
+            return MasterAdamState(m=m, v=v, master=master)
+        m, v = _moment_init(params, moment_dtype)
+        return AdamState(m=m, v=v)
 
-    def update(grads, state: AdamState, params, step):
+    def update(grads, state, params, step):
         lr = schedule(jnp.asarray(step))
         mask = _decay_mask(params, exclusions)
+        has_master = isinstance(state, MasterAdamState)
+        masters = state.master if has_master else params
 
-        def one(param, grad, m, v, use_decay):
-            grad = grad.astype(m.dtype)
+        def one(param, grad, m, v, master, use_decay):
+            grad = cast_grad(grad, m.dtype)
             next_m = beta_1 * m + (1.0 - beta_1) * grad
             next_v = beta_2 * v + (1.0 - beta_2) * jnp.square(grad)
             upd = next_m / (jnp.sqrt(next_v) + epsilon)
             if use_decay and weight_decay_rate:
-                upd = upd + weight_decay_rate * param
-            new_param = param - lr * upd
-            return new_param, next_m, next_v
+                # decay references the MASTER value (== param when no
+                # master), so the decay path never quantizes through bf16
+                upd = upd + weight_decay_rate * master
+            new_master = master - lr * upd
+            return new_master.astype(param.dtype), next_m, next_v, new_master
 
-        new_params, new_m, new_v = _leafwise(
-            3, one, params, grads, state.m, state.v, mask
+        new_params, new_m, new_v, new_master = _leafwise(
+            4, one, params, grads, state.m, state.v, masters, mask
         )
+        if has_master:
+            return new_params, MasterAdamState(m=new_m, v=new_v,
+                                               master=new_master)
         return new_params, AdamState(m=new_m, v=new_v)
 
-    return Optimizer(init=init, update=update)
+    # -- FusedAccum hooks (AdamA): moment fold shared via
+    # _fused_moment_hooks; only apply is adamw-specific -------------------
+
+    fused_moments, fused_carry_into, fused_accumulate = _fused_moment_hooks(
+        beta_1, beta_2, cast_grad
+    )
+
+    def fused_apply(state, mv, params, step):
+        m_tree, v_tree = mv
+        lr = schedule(jnp.asarray(step))
+        mask = _decay_mask(params, exclusions)
+        has_master = isinstance(state, MasterAdamState)
+        masters = state.master if has_master else params
+
+        def one(param, m, v, master, use_decay):
+            upd = m / (jnp.sqrt(v) + epsilon)
+            if use_decay and weight_decay_rate:
+                upd = upd + weight_decay_rate * master
+            new_master = master - lr * upd
+            return new_master.astype(param.dtype), new_master
+
+        new_params, new_master = _leafwise(
+            2, one, params, m_tree, v_tree, masters, mask
+        )
+        if has_master:
+            return new_params, MasterAdamState(m=m_tree, v=v_tree,
+                                               master=new_master)
+        return new_params, AdamState(m=m_tree, v=v_tree)
+
+    return Optimizer(
+        init=init, update=update,
+        fused=FusedAccum(moments=fused_moments, carry_into=fused_carry_into,
+                         accumulate=fused_accumulate, apply=fused_apply),
+    )
 
 
 def adam(
@@ -134,6 +347,8 @@ def adam(
     beta_1: float = 0.9,
     beta_2: float = 0.999,
     epsilon: float = 1e-8,
+    master_dtype: Any = None,
+    moment_dtype: Any = None,
 ) -> Optimizer:
     """Classic Adam with bias correction — ``tf.train.AdamOptimizer`` semantics.
 
@@ -142,33 +357,79 @@ def adam(
     ``param -= alpha_t * m / (sqrt(v) + eps_hat)``. ``t`` is the number of
     updates applied so far **plus one** — independent of the caller's
     micro-batch step counter, so it lives in the optimizer state.
+
+    ``master_dtype`` / ``moment_dtype``: same mixed-precision contract as
+    :func:`adamw`.
     """
     schedule = as_schedule(learning_rate)
+    cast_grad = _grad_caster(moment_dtype is not None)
 
     def init(params):
-        return AdamBCState(
-            t=jnp.zeros((), dtype=jnp.int32),
-            m=tree_zeros_like(params),
-            v=tree_zeros_like(params),
-        )
+        t = jnp.zeros((), dtype=jnp.int32)
+        if master_dtype is not None:
+            m, v, master = _master_init(params, master_dtype, moment_dtype)
+            return MasterAdamBCState(t=t, m=m, v=v, master=master)
+        m, v = _moment_init(params, moment_dtype)
+        return AdamBCState(t=t, m=m, v=v)
+
+    def _alpha(lr, t):
+        tf32 = t.astype(jnp.float32)
+        return lr * jnp.sqrt(1.0 - beta_2**tf32) / (1.0 - beta_1**tf32)
 
     def update(grads, state, params, step):
         lr = schedule(jnp.asarray(step))
         t = state.t + 1
-        tf32 = t.astype(jnp.float32)
-        alpha = lr * jnp.sqrt(1.0 - beta_2**tf32) / (1.0 - beta_1**tf32)
+        alpha = _alpha(lr, t)
+        has_master = isinstance(state, MasterAdamBCState)
+        masters = state.master if has_master else params
 
-        def one(param, grad, m, v):
-            grad = grad.astype(m.dtype)
+        def one(param, grad, m, v, master):
+            grad = cast_grad(grad, m.dtype)
             next_m = beta_1 * m + (1.0 - beta_1) * grad
             next_v = beta_2 * v + (1.0 - beta_2) * jnp.square(grad)
-            new_param = param - alpha * next_m / (jnp.sqrt(next_v) + epsilon)
-            return new_param, next_m, next_v
+            new_master = master - alpha * next_m / (jnp.sqrt(next_v) + epsilon)
+            return new_master.astype(param.dtype), next_m, next_v, new_master
 
-        new_params, new_m, new_v = _leafwise(3, one, params, grads, state.m, state.v)
+        new_params, new_m, new_v, new_master = _leafwise(
+            4, one, params, grads, state.m, state.v, masters
+        )
+        if has_master:
+            return new_params, MasterAdamBCState(t=t, m=new_m, v=new_v,
+                                                 master=new_master)
         return new_params, AdamBCState(t=t, m=new_m, v=new_v)
 
-    return Optimizer(init=init, update=update)
+    # -- FusedAccum hooks: the moment fold is the shared implementation;
+    # bias correction only touches apply (t bumps once per WINDOW, and an
+    # all-bad window's cond-skip keeps the old t — bitwise no-op holds).
+
+    fused_moments, fused_carry_into, fused_accumulate = _fused_moment_hooks(
+        beta_1, beta_2, cast_grad
+    )
+
+    def fused_apply(state, mv, params, step):
+        m_tree, v_tree = mv
+        lr = schedule(jnp.asarray(step))
+        t = state.t + 1
+        alpha = _alpha(lr, t)
+        has_master = isinstance(state, MasterAdamBCState)
+        masters = state.master if has_master else params
+
+        def one(param, m, v, master):
+            new_master = master - alpha * m / (jnp.sqrt(v) + epsilon)
+            return new_master.astype(param.dtype), new_master
+
+        new_params, new_master = _leafwise(2, one, params, m_tree, v_tree,
+                                           masters)
+        if has_master:
+            return new_params, MasterAdamBCState(t=t, m=m_tree, v=v_tree,
+                                                 master=new_master)
+        return new_params, AdamBCState(t=t, m=m_tree, v=v_tree)
+
+    return Optimizer(
+        init=init, update=update,
+        fused=FusedAccum(moments=fused_moments, carry_into=fused_carry_into,
+                         accumulate=fused_accumulate, apply=fused_apply),
+    )
 
 
 def sgd(learning_rate, momentum: float = 0.0) -> Optimizer:
@@ -182,11 +443,20 @@ def sgd(learning_rate, momentum: float = 0.0) -> Optimizer:
 
     def update(grads, state, params, step):
         lr = schedule(jnp.asarray(step))
+        # cast back to the storage dtypes: the accumulation window hands
+        # over f32 gradients even for low-precision params, and the update
+        # must not silently promote them (no-op for f32 training)
         if momentum:
-            new_state = jax.tree.map(lambda b, g: momentum * b + g, state, grads)
-            new_params = jax.tree.map(lambda p, b: p - lr * b, params, new_state)
+            new_state = jax.tree.map(
+                lambda b, g: (momentum * b + g).astype(b.dtype), state, grads
+            )
+            new_params = jax.tree.map(
+                lambda p, b: (p - lr * b).astype(p.dtype), params, new_state
+            )
             return new_params, new_state
-        new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        new_params = jax.tree.map(
+            lambda p, g: (p - lr * g).astype(p.dtype), params, grads
+        )
         return new_params, state
 
     return Optimizer(init=init, update=update)

@@ -18,7 +18,13 @@ from gradaccum_tpu.parallel.pp import (
     pp_init,
     stack_stage_params,
 )
-from gradaccum_tpu.parallel.zero import zero1_shard_state, zero1_state_shardings
+from gradaccum_tpu.parallel.zero import (
+    make_zero1_train_step,
+    zero1_optimizer,
+    zero1_shard_state,
+    zero1_state_shardings,
+    zero1_state_specs,
+)
 from gradaccum_tpu.parallel.mesh import (
     DATA_AXIS,
     EXPERT_AXIS,

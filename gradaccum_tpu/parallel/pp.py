@@ -47,6 +47,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from gradaccum_tpu.ops.accumulation import _grads_finite
 from gradaccum_tpu.ops.adamw import Optimizer
+from gradaccum_tpu.ops.loss_scale import (
+    LossScaleConfig,
+    init_loss_scale,
+    update_loss_scale,
+)
 from gradaccum_tpu.parallel.mesh import PIPE_AXIS
 from gradaccum_tpu.utils import compat
 
@@ -60,6 +65,11 @@ class PPState(NamedTuple):
     params: Any  # stage-stacked [P, ...] per leaf, or a PipelineParams
     opt_state: Any  # same stacking
     step: jnp.ndarray
+    # ops.loss_scale.DynamicLossScale when the step is built with a
+    # loss_scale config, else None (an empty pytree node — states and
+    # checkpoints from before this field keep their schema, exactly like
+    # ScanState.loss_scale)
+    loss_scale: Any = None
 
 
 class PipelineParams(NamedTuple):
@@ -98,6 +108,7 @@ def pp_init(
     optimizer: Optimizer,
     pre_params: Any = None,
     post_params: Any = None,
+    loss_scale: "LossScaleConfig | None" = None,
 ) -> PPState:
     params = stack_stage_params(stage_params_list)
     if pre_params is not None or post_params is not None:
@@ -106,6 +117,7 @@ def pp_init(
         params=params,
         opt_state=optimizer.init(params),
         step=jnp.zeros((), jnp.int32),
+        loss_scale=None if loss_scale is None else init_loss_scale(loss_scale),
     )
 
 
@@ -219,6 +231,7 @@ def make_pp_train_step(
     clip_norm: float | None = None,
     skip_nonfinite: bool = False,
     normalize_by_good_count: bool = False,
+    loss_scale: "LossScaleConfig | None" = None,
 ):
     """Build ``train_step(state, batch) -> (state, aux)``.
 
@@ -267,12 +280,29 @@ def make_pp_train_step(
     gradients themselves (in-stage overflow can still pollute that stage's
     backward) and cond-skips the whole apply — params and moments carry
     over bitwise, mirroring the scan path's all-bad-window contract.
+
+    ``loss_scale`` (dynamic loss scaling, the scan/streaming contract on
+    the GPipe schedule): the last rank's loss is multiplied by the live
+    scale before differentiation, the guard's loss check and the final
+    gradient net therefore see SCALED values (an overflow at the current
+    scale flags the window exactly as an injected NaN would), the unscale
+    folds in before clip/apply so the optimizer sees true-magnitude
+    gradients, and the scale halves on a dirty window / regrows after
+    ``growth_interval`` clean ones at every window boundary — applied or
+    not. The :class:`DynamicLossScale` rides ``PPState.loss_scale``
+    (checkpointed; ``pp_init(..., loss_scale=...)``). Requires
+    ``skip_nonfinite=True`` — overflow detection IS the guard.
     """
     k = num_micro_batches
     skip = skip_nonfinite
     if normalize_by_good_count and not skip:
         raise ValueError(
             "normalize_by_good_count requires skip_nonfinite=True"
+        )
+    if loss_scale is not None and not skip:
+        raise ValueError(
+            "dynamic loss scaling detects overflow through the non-finite "
+            "guard; it requires skip_nonfinite=True"
         )
 
     def step(state: PPState, batch):
@@ -286,6 +316,13 @@ def make_pp_train_step(
             local_stages,
             state.params.post if has_prepost else None,
         )
+        if loss_scale is not None and state.loss_scale is None:
+            raise ValueError(
+                "the step was built with loss_scale but the PPState carries "
+                "no DynamicLossScale — build it with pp_init(..., "
+                "loss_scale=...)"
+            )
+        scale = state.loss_scale.scale if loss_scale is not None else None
         if skip:
             # (1) the batch guard runs OUTSIDE the differentiated function
             # (batches carry no gradient): bad micro-batches are zeroed so
@@ -329,9 +366,13 @@ def make_pp_train_step(
                 # (3) loss check is meaningful on the last rank only (the
                 # others ran on zeros); everyone else votes 1 so the pmin
                 # broadcasts the last rank's verdict
+                # with loss scaling the SCALED loss is what overflow shows
+                # up in, so that is what gets checked (the logged loss_sum
+                # below stays raw)
+                check = losses if scale is None else losses * scale
                 loss_ok = jnp.where(
                     idx == n - 1,
-                    jnp.isfinite(losses).astype(jnp.int32),
+                    jnp.isfinite(check).astype(jnp.int32),
                     jnp.ones((k,), jnp.int32),
                 )
                 g = jnp.minimum(jnp.minimum(stage_good, loss_ok), good_in)
@@ -357,13 +398,18 @@ def make_pp_train_step(
                 local = jnp.mean(losses)
             # only the last rank saw real outputs; broadcast its loss
             pipe_loss = lax.psum(jnp.where(idx == n - 1, local, 0.0), axis)
-            if data_axis is None:
-                return pipe_loss, aux
-            # global-mean loss INSIDE the differentiated function: autodiff's
-            # transpose then yields the cross-replica mean gradient directly
-            # (shard_map's vma-aware transpose already psums cotangents onto
-            # data-replicated params — a post-hoc pmean would double-count)
-            return lax.pmean(pipe_loss, data_axis), aux
+            if data_axis is not None:
+                # global-mean loss INSIDE the differentiated function:
+                # autodiff's transpose then yields the cross-replica mean
+                # gradient directly (shard_map's vma-aware transpose already
+                # psums cotangents onto data-replicated params — a post-hoc
+                # pmean would double-count)
+                pipe_loss = lax.pmean(pipe_loss, data_axis)
+            if scale is not None:
+                # differentiate the SCALED loss so small bf16 cotangents
+                # survive the backward; unscaled below before clip/apply
+                pipe_loss = pipe_loss * scale
+            return pipe_loss, aux
 
         (loss, fwd_aux), (g_pre, g_stages, g_post) = jax.value_and_grad(
             fwd, has_aux=True
@@ -391,6 +437,19 @@ def make_pp_train_step(
                 g_pre, g_stages, g_post = lax.pmean(
                     (g_pre, g_stages, g_post), data_axis
                 )
+        if scale is not None:
+            # unscale BEFORE clip/apply (the denominator fold of the scan
+            # path): the optimizer only ever sees true-magnitude gradients.
+            # f32 arithmetic so low-precision grads divide cleanly; an Inf
+            # or NaN the scaled backward produced survives the division for
+            # the final net below to catch.
+            unscale = lambda tree: jax.tree.map(
+                lambda g: (g.astype(jnp.float32) / scale).astype(g.dtype),
+                tree,
+            )
+            g_pre, g_stages, g_post = (
+                unscale(g_pre), unscale(g_stages), unscale(g_post),
+            )
         if clip_norm is not None:
             sq = lambda tree: sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -399,11 +458,13 @@ def make_pp_train_step(
             local_sq = sq(g_stages)
             total_sq = lax.psum(local_sq, axis) + sq(g_pre) + sq(g_post)
             norm = jnp.sqrt(total_sq)
-            scale = jnp.asarray(clip_norm, jnp.float32) / jnp.maximum(
+            # NOT `scale` — that name is the live loss scale above
+            clip_scale = jnp.asarray(clip_norm, jnp.float32) / jnp.maximum(
                 norm, clip_norm
             )
             clip = lambda tree: jax.tree.map(
-                lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), tree
+                lambda g: (g.astype(jnp.float32) * clip_scale).astype(g.dtype),
+                tree,
             )
             g_pre, g_stages, g_post = clip(g_pre), clip(g_stages), clip(g_post)
         # re-stack to the [1, ...] local slice of the stage-stacked layout
@@ -447,7 +508,19 @@ def make_pp_train_step(
                 grads, state.opt_state, state.params, apply_step
             )
             aux = {"loss": loss}
-        return (PPState(new_params, new_opt_state, apply_step), aux)
+        if loss_scale is not None:
+            # window boundary: the scale self-adjusts whether or not the
+            # apply ran (an all-bad window is maximally dirty)
+            new_ls = update_loss_scale(
+                state.loss_scale, loss_scale, n_good >= k
+            )
+            aux["loss_scale"] = new_ls.scale
+        else:
+            new_ls = state.loss_scale
+        return (
+            PPState(new_params, new_opt_state, apply_step, loss_scale=new_ls),
+            aux,
+        )
 
     n_stages = dict(mesh.shape)[axis]
 
@@ -491,6 +564,9 @@ def make_pp_train_step(
             params=params_spec,
             opt_state=jax.tree.map(opt_spec, state.opt_state, single_opt),
             step=P(),
+            # DynamicLossScale scalars are replicated (None when off — an
+            # empty pytree node needs no spec leaves)
+            loss_scale=jax.tree.map(lambda _: P(), state.loss_scale),
         )
 
     def batch_leaf_spec(leaf):

@@ -47,6 +47,7 @@ def make_dp_sp_train_step(
     seq_axis: str = SEQ_AXIS,
     seq_keys: Sequence[str] = DEFAULT_SEQ_KEYS,
     needs_rng: bool = False,
+    zero1: bool = False,
 ):
     """Scan-mode accumulation step over a ``(data, seq)`` mesh.
 
@@ -62,11 +63,23 @@ def make_dp_sp_train_step(
     is zero-substituted on ALL of them (anything less would diverge the
     accumulators) — while the ``data`` shards keep their independent
     verdicts and the psum'd good count keeps the denominator honest.
+
+    ``zero1=True`` shards the optimizer state over ``data_axis``
+    (:func:`gradaccum_tpu.parallel.zero.zero1_optimizer`): the one
+    window-boundary psum is followed by a sharded update and a param
+    all-gather instead of a replicated update — long-context sp training
+    with per-device optimizer memory divided by the data width. Place the
+    state with :func:`...zero.zero1_shard_state` (the Estimator does).
     """
     config = config._replace(
         axis_name=data_axis,
         example_axes=tuple(config.example_axes) + (seq_axis,),
     )
+    n_data = dict(mesh.shape)[data_axis]
+    if zero1:
+        from gradaccum_tpu.parallel.zero import zero1_optimizer
+
+        optimizer = zero1_optimizer(optimizer, data_axis, n=n_data)
     inner = acc.accumulate_scan(loss_fn, optimizer, config, needs_rng=needs_rng)
 
     def batch_specs(batch):
@@ -83,10 +96,19 @@ def make_dp_sp_train_step(
     def train_step(state, super_batch, *rng):
         key_set = tuple(sorted(super_batch))
         if key_set not in jitted:
-            in_specs = (P(), batch_specs(super_batch)) + ((P(),) if rng else ())
+            if zero1:
+                from gradaccum_tpu.parallel.zero import zero1_state_specs
+
+                state_specs = zero1_state_specs(state, n_data, axis=data_axis)
+            else:
+                state_specs = P()
+            in_specs = (state_specs, batch_specs(super_batch)) + (
+                (P(),) if rng else ()
+            )
             jitted[key_set] = jax.jit(
                 compat.shard_map(
-                    inner, mesh=mesh, in_specs=in_specs, out_specs=(P(), P())
+                    inner, mesh=mesh, in_specs=in_specs,
+                    out_specs=(state_specs, P()),
                 ),
                 donate_argnums=0,
             )

@@ -50,6 +50,22 @@ def tree_map_with_names(fn, tree, *rest):
     return tree_util.tree_map_with_path(_fn, tree, *rest)
 
 
+def tree_cast_floating(tree, dtype):
+    """Cast every floating-point leaf to ``dtype``, leaving integer/bool
+    leaves untouched — how a ModelBundle's ``compute_dtype`` knob turns an
+    f32-initialized parameter tree into bf16 working params (the f32
+    master copy then lives in the optimizer state; see
+    ``ops.adamw.adamw(master_dtype=...)``). ``dtype=None`` is the identity,
+    so bundles can call this unconditionally on the knob's value."""
+    if dtype is None:
+        return tree
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+        tree,
+    )
+
+
 def tree_zeros_like(tree):
     """Zero-initialized tree — the accumulator allocation of optimization.py:78."""
     return jax.tree.map(jnp.zeros_like, tree)

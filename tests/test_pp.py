@@ -389,3 +389,72 @@ def test_bert_pipeline_remat_matches(rng):
         ),
         outs[False][1], outs[True][1],
     )
+
+
+def test_pp_loss_scale_matches_unscaled_then_halve_regrow(rng):
+    """GradAccumConfig.loss_scale threaded through make_pp_train_step. One
+    compiled pair of steps gates three contracts: (a) power-of-two scales
+    round-trip exactly, so a scaled run on clean data matches the unscaled
+    guarded run bit-for-bit; (b) an all-bad window leaves params+moments
+    bitwise untouched and halves the scale; (c) growth_interval clean
+    windows regrow it."""
+    from gradaccum_tpu.ops.loss_scale import LossScaleConfig
+
+    k = 2
+    mesh = make_mesh(pipe=2, devices=jax.devices()[:2])
+    opt = adamw(1e-3, weight_decay_rate=0.01)
+    ls = LossScaleConfig(init_scale=16.0, growth_interval=2)
+    stages = make_stages(rng, 2)
+    step_u = make_pp_train_step(stage_fn, loss_fn, opt, k, mesh,
+                                skip_nonfinite=True)
+    step_s = make_pp_train_step(stage_fn, loss_fn, opt, k, mesh,
+                                skip_nonfinite=True, loss_scale=ls)
+    su = pp_init(stages, opt)
+    ss = pp_init(stages, opt, loss_scale=ls)
+    for _ in range(3):
+        batch = _batch(rng, k)
+        su, au = step_u(su, batch)
+        ss, a_s = step_s(ss, batch)
+    for lu, lsc in zip(jax.tree.leaves(jax.device_get(su.params)),
+                       jax.tree.leaves(jax.device_get(ss.params))):
+        np.testing.assert_array_equal(np.asarray(lu), np.asarray(lsc))
+    np.testing.assert_allclose(float(a_s["loss"]), float(au["loss"]),
+                               rtol=1e-6)
+    scale0 = float(a_s["loss_scale"])
+    assert scale0 == 32.0  # one regrow after 2 clean windows
+
+    before = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x)),
+        (ss.params, ss.opt_state),
+    )
+    bad = _batch(rng, k)
+    bad["x"] = bad["x"].at[:].set(jnp.nan)
+    ss, aux = step_s(ss, bad)
+    after = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x)),
+        (ss.params, ss.opt_state),
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, b), before, after
+    )
+    assert int(aux["good_count"]) == 0
+    assert np.isnan(float(aux["loss"]))
+    assert float(aux["loss_scale"]) == scale0 / 2
+    for _ in range(2):
+        ss, aux = step_s(ss, _batch(rng, k))
+    assert float(aux["loss_scale"]) == scale0  # regrown
+
+
+def test_pp_loss_scale_requires_guard_and_state(rng):
+    from gradaccum_tpu.ops.loss_scale import LossScaleConfig
+
+    mesh = make_mesh(pipe=2, devices=jax.devices()[:2])
+    opt = adamw(1e-3)
+    with pytest.raises(ValueError, match="skip_nonfinite"):
+        make_pp_train_step(stage_fn, loss_fn, opt, 2, mesh,
+                           loss_scale=LossScaleConfig())
+    step = make_pp_train_step(stage_fn, loss_fn, opt, 2, mesh,
+                              skip_nonfinite=True,
+                              loss_scale=LossScaleConfig())
+    with pytest.raises(ValueError, match="DynamicLossScale"):
+        step(pp_init(make_stages(rng, 2), opt), _batch(rng, 2))

@@ -145,3 +145,57 @@ def test_sp_forward_matches_dense(rng):
     )
     got = predict(params, batch)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_dp_sp_zero1_matches_unsharded_update(rng):
+    """make_dp_sp_train_step(zero1=True): the sharded-update/all-gather
+    path must reproduce the replicated-update sp step (same psum'd window
+    gradient, same elementwise math on each shard), with the optimizer
+    state actually sharded over 'data'."""
+    from gradaccum_tpu.parallel.zero import zero1_shard_state
+
+    cfg = _cfg()
+    mesh = make_mesh(data=2, seq=2, devices=jax.devices()[:4])
+    batch = _batch(rng, cfg)
+    opt = gt.ops.adamw(1e-3, weight_decay_rate=0.01)
+    sp_bundle = bert_classifier_bundle(
+        cfg, num_classes=2,
+        attention_fn=make_ring_attention_fn("seq"), seq_axis="seq",
+    )
+    # host copy: both legs donate their state, which would otherwise free
+    # the shared init arrays under the second leg
+    params = jax.device_get(sp_bundle.init(jax.random.PRNGKey(0), batch))
+    fresh = lambda: jax.tree.map(jnp.asarray, params)
+    accum = gt.GradAccumConfig(num_micro_batches=K, clip_norm=1.0)
+    stacked = gt.stack_micro_batches(batch, K)
+
+    ref_step = make_dp_sp_train_step(
+        sp_bundle.loss, opt, accum, mesh, needs_rng=True,
+    )
+    ref_state, ref_aux = ref_step(
+        scan_init(fresh(), opt), stacked, jax.random.PRNGKey(7)
+    )
+
+    z_step = make_dp_sp_train_step(
+        sp_bundle.loss, opt, accum, mesh, needs_rng=True, zero1=True,
+    )
+    z0 = zero1_shard_state(scan_init(fresh(), opt), mesh)
+    z_state, z_aux = z_step(z0, stacked, jax.random.PRNGKey(7))
+
+    np.testing.assert_allclose(float(z_aux["loss"]), float(ref_aux["loss"]),
+                               rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        jax.device_get(z_state.params), jax.device_get(ref_state.params),
+    )
+    sharded = [
+        l for l in jax.tree.leaves(z_state.opt_state)
+        if hasattr(l, "sharding") and "data" in str(l.sharding.spec)
+    ]
+    assert sharded, "sp zero1 left every moment replicated"
+    assert all(
+        l.sharding.is_fully_replicated for l in jax.tree.leaves(z_state.params)
+    )

@@ -1,0 +1,215 @@
+"""BENCH_mixed: memory + step time across the mixed-precision ladder.
+
+Four training configurations of the same tiny GPT, measured for (a)
+per-replica optimizer-state + gradient-accumulator bytes per parameter —
+the number the mixed-precision stack exists to shrink — and (b) wall-clock
+scan-step time:
+
+- ``f32``            — the two-pass baseline: f32 params, AdamW moments,
+                       one f32 gradient accumulator (m+v+accum = 12 B/param).
+- ``bf16+master``    — bf16 params, f32 masters in the optimizer state
+                       (m+v+master+accum = 16 B/param of optimizer memory:
+                       mixed precision TRADES optimizer bytes for halved
+                       param/activation/grad bytes — reported honestly).
+- ``bf16+fused``     — fused Adam-accumulation (AdamA): the accumulator is
+                       gone (m+v+master = 12 B/param).
+- ``bf16+fused+zero1`` — the full stack on a 2-replica data mesh: the
+                       sharded optimizer state costs 6 B/param per replica.
+
+Memory is measured from the REAL TrainState pytrees (leaf nbytes, divided
+by the shard count the leaf's sharding reports), plus the accumulator the
+step carries (the scan carry for two-pass modes, zero for fused; streaming
+mode's persistent ``accum_grads`` would count the same way). The
+acceptance bar is the ISSUE 9 contract: >= 1.8x reduction in per-replica
+optimizer+accumulator bytes/param for bf16+fused+zero1 (2 replicas) vs the
+f32 baseline.
+
+Usage: python tools/bench_mixed.py [--out BENCH_mixed.json] [--steps N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle  # noqa: E402
+from gradaccum_tpu.ops import accumulation as acc  # noqa: E402
+from gradaccum_tpu.ops.adamw import adamw  # noqa: E402
+from gradaccum_tpu.parallel.mesh import make_mesh  # noqa: E402
+from gradaccum_tpu.parallel.sharding import (  # noqa: E402
+    batch_sharding,
+    replicated,
+)
+from gradaccum_tpu.parallel.zero import (  # noqa: E402
+    zero1_shard_state,
+    zero1_state_shardings,
+)
+
+K = 4
+MICRO = 8
+SEQ = 64
+
+
+def _gpt_cfg():
+    return GPTConfig(
+        vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+        intermediate_size=256, max_position_embeddings=SEQ, dropout=0.0,
+    )
+
+
+def _batch(rng):
+    ids = rng.integers(0, 512, size=(K * MICRO, SEQ)).astype(np.int32)
+    return acc.stack_micro_batches({"input_ids": jnp.asarray(ids)}, K)
+
+
+def _per_replica_bytes(tree):
+    """Sum leaf bytes as stored on ONE device: a leaf sharded N ways holds
+    nbytes/N per replica (read from the actual sharding, not assumed)."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        n_shards = 1
+        sh = getattr(leaf, "sharding", None)
+        if sh is not None and not sh.is_fully_replicated:
+            n_shards = sh.num_devices
+        total += leaf.nbytes // n_shards
+    return total
+
+
+def run_config(name, rng, steps, compute_dtype=None, fused=False,
+               zero1=False):
+    cfg = _gpt_cfg()
+    bundle = gpt_lm_bundle(cfg, compute_dtype=compute_dtype)
+    opt = adamw(
+        1e-3, weight_decay_rate=0.01,
+        master_dtype=None if compute_dtype is None else jnp.float32,
+    )
+    accum_cfg = acc.GradAccumConfig(num_micro_batches=K, fused_adam=fused)
+    batch = _batch(rng)
+    params = bundle.init(jax.random.PRNGKey(0),
+                         {"input_ids": batch["input_ids"][0]})
+    state = acc.scan_init(params, opt)
+    step = acc.accumulate_scan(bundle.loss, opt, accum_cfg, needs_rng=True)
+    if zero1:
+        mesh = make_mesh(data=2, devices=jax.devices()[:2])
+        state = zero1_shard_state(state, mesh)
+        sh = zero1_state_shardings(state, mesh)
+        rep = replicated(mesh)
+        jitted = jax.jit(
+            step,
+            in_shardings=(sh, batch_sharding(mesh, leading_unsharded=1), rep),
+            out_shardings=(sh, rep),
+            donate_argnums=0,
+        )
+    else:
+        jitted = jax.jit(step, donate_argnums=0)
+
+    key = jax.random.PRNGKey(7)
+    state, aux = jitted(state, batch, key)  # compile + step 1
+    jax.block_until_ready(aux["loss"])
+    first_loss = float(jax.device_get(aux["loss"]))
+
+    n_params = sum(l.size for l in jax.tree.leaves(state.params))
+    opt_bytes = _per_replica_bytes(state.opt_state)
+    # the accumulation window's gradient accumulator: one f32 tree for the
+    # two-pass modes (live for the whole scan), zero when fused folds it
+    # into the moments
+    accum_bytes = 0 if fused else 4 * n_params
+    param_bytes = _per_replica_bytes(state.params)
+
+    times = []
+    for i in range(steps):
+        t0 = time.perf_counter()
+        state, aux = jitted(state, batch, jax.random.fold_in(key, i))
+        jax.block_until_ready(aux["loss"])
+        times.append(time.perf_counter() - t0)
+    loss = float(jax.device_get(aux["loss"]))
+    return {
+        "first_loss": round(first_loss, 5),
+        "config": name,
+        "n_params": int(n_params),
+        "param_bytes_per_param": round(param_bytes / n_params, 4),
+        "optimizer_bytes_per_param": round(opt_bytes / n_params, 4),
+        "accumulator_bytes_per_param": round(accum_bytes / n_params, 4),
+        "opt_plus_accum_bytes_per_param": round(
+            (opt_bytes + accum_bytes) / n_params, 4
+        ),
+        "step_time_ms_median": round(1e3 * float(np.median(times)), 2),
+        "final_loss": round(loss, 5),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir,
+        "BENCH_mixed.json"))
+    ap.add_argument("--steps", type=int, default=16)
+    args = ap.parse_args(argv)
+
+    rng = np.random.default_rng(20260803)
+    legs = [
+        ("f32", dict()),
+        ("bf16+master", dict(compute_dtype=jnp.bfloat16)),
+        ("bf16+fused", dict(compute_dtype=jnp.bfloat16, fused=True)),
+        ("bf16+fused+zero1", dict(compute_dtype=jnp.bfloat16, fused=True,
+                                  zero1=True)),
+    ]
+    rows = []
+    for name, kw in legs:
+        row = run_config(name, rng, args.steps, **kw)
+        rows.append(row)
+        print(f"[{row['config']:>17}] opt+accum "
+              f"{row['opt_plus_accum_bytes_per_param']:5.2f} B/param  "
+              f"params {row['param_bytes_per_param']:4.2f} B/param  "
+              f"step {row['step_time_ms_median']:7.2f} ms  "
+              f"loss {row['final_loss']}")
+
+    base = rows[0]["opt_plus_accum_bytes_per_param"]
+    headline = rows[-1]["opt_plus_accum_bytes_per_param"]
+    reduction = base / headline
+    # loss sanity: every leg actually trains (the bf16-vs-f32 tolerance
+    # gate proper lives in tests/test_mixed.py, on equal step counts)
+    all_train = all(r["final_loss"] < r["first_loss"] for r in rows)
+    passed = reduction >= 1.8 and all_train
+    result = {
+        "bench": "mixed-precision memory ladder (tiny GPT, K=4 scan, "
+                 "2 simulated replicas for zero1)",
+        "headline": f"{reduction:.2f}x lower per-replica optimizer+"
+                    f"accumulator bytes/param (bf16+fused+zero1 vs f32 "
+                    f"two-pass)",
+        "rows": rows,
+        "reduction_vs_f32": round(reduction, 3),
+        "all_legs_train": bool(all_train),
+        "acceptance": {
+            "required": ">=1.8x reduction in per-replica optimizer+"
+                        "accumulator bytes/param for bf16+fused+zero1 "
+                        "(2 replicas) vs the f32 baseline, every leg's "
+                        "loss decreasing over the run",
+            "measured": round(reduction, 3),
+            "passed": bool(passed),
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(f"wrote {args.out}: reduction {reduction:.2f}x "
+          f"({'PASS' if passed else 'FAIL'})")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,22 @@ def _overhead_column(data) -> str:
     return "overhead " + ", ".join(parts)
 
 
+def _memory_column(data) -> str:
+    """Render a mixed-precision ``rows`` ladder (BENCH_mixed.json) as the
+    per-replica optimizer+accumulator bytes/param progression."""
+    rows = data.get("rows")
+    if not isinstance(rows, list) or not rows:
+        return ""
+    try:
+        parts = [
+            f"{r['config']} {float(r['opt_plus_accum_bytes_per_param']):g}B"
+            for r in rows
+        ]
+    except (KeyError, TypeError, ValueError):
+        return ""
+    return "opt+accum/param " + " → ".join(parts)
+
+
 def collect(bench_dir: str):
     """One record per BENCH_*.json: name, headline, acceptance (or None).
     MULTICHIP_r*.json dryrun artifacts ride along: ok -> PASS, skipped ->
@@ -86,6 +102,7 @@ def collect(bench_dir: str):
             "headline": data.get("headline"),
             "scaling": _scaling_column(data) or None,
             "overhead": _overhead_column(data) or None,
+            "memory": _memory_column(data) or None,
             "acceptance": acceptance,
             "passed": None if acceptance is None
             else bool(acceptance.get("passed")),
@@ -150,6 +167,8 @@ def main(argv=None) -> int:
                 detail += f" — {r['scaling']}"
             if r.get("overhead"):
                 detail += f" — {r['overhead']}"
+            if r.get("memory"):
+                detail += f" — {r['memory']}"
             if required != "":
                 detail += f" [{required}]"
             if not r["passed"]:
