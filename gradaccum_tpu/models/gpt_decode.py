@@ -133,7 +133,12 @@ def _compact_ragged(k_stack, v_stack, pad, lengths, out_len: int):
     return k_stack, v_stack
 
 
-def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> DecodeCache:
+def init_cache(cfg: GPTConfig, batch: int, max_len: int,
+               cache_dtype=None) -> DecodeCache:
+    """``cache_dtype`` stores K/V at a narrower width than the compute
+    dtype (bf16 halves pool bytes); reads upcast to the compute dtype at
+    the attention matmul, writes downcast at the scatter. None keeps the
+    cache at ``cfg.dtype`` exactly as before."""
     if max_len > cfg.max_position_embeddings:
         raise ValueError(
             f"max_len {max_len} exceeds max_position_embeddings "
@@ -141,11 +146,36 @@ def init_cache(cfg: GPTConfig, batch: int, max_len: int) -> DecodeCache:
         )
     hd = cfg.hidden_size // cfg.num_heads
     shape = (cfg.num_layers, batch, cfg.num_heads, max_len, hd)
+    dtype = cfg.dtype if cache_dtype is None else cache_dtype
     return DecodeCache(
-        k=jnp.zeros(shape, cfg.dtype),
-        v=jnp.zeros(shape, cfg.dtype),
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
         length=jnp.zeros((), jnp.int32),
     )
+
+
+def truncate_draft_params(params, cfg: GPTConfig, num_layers: int):
+    """A draft model carved from the target's own weights: the first
+    ``num_layers`` blocks plus the (tied) embeddings and final LayerNorm,
+    sharing every dimension with the target except depth. Returns
+    ``(draft_params, draft_cfg)`` ready for the speculative-decoding
+    engine (``Engine(speculate_k=, draft_params=, draft_cfg=)``). The
+    leaves are the SAME arrays as the target's (no copy) — a draft is a
+    view, not a second checkpoint. Distilled drafts drop in the same way:
+    any GPT params/config pair with the target's vocab works."""
+    if not 1 <= num_layers <= cfg.num_layers:
+        raise ValueError(
+            f"draft num_layers must be in [1, {cfg.num_layers}], "
+            f"got {num_layers}"
+        )
+    import dataclasses
+
+    p = params["params"]
+    keep = {name: leaf for name, leaf in p.items()
+            if not name.startswith("layer_")}
+    for i in range(num_layers):
+        keep[f"layer_{i}"] = p[f"layer_{i}"]
+    return {"params": keep}, dataclasses.replace(cfg, num_layers=num_layers)
 
 
 def prefill(params, cfg: GPTConfig, prompt_ids, max_len: int, lengths=None):
@@ -243,7 +273,8 @@ def decode_step(params, cfg: GPTConfig, cache: DecodeCache, token):
             new_v = jax.lax.dynamic_update_slice(
                 new_v, v[None].astype(new_v.dtype), (i, 0, 0, pos, 0)
             )
-            return _attend(q, new_k[i], new_v[i], pos_mask), None
+            return _attend(q, new_k[i].astype(q.dtype),
+                           new_v[i].astype(q.dtype), pos_mask), None
 
         x, _ = _block(cfg, p[f"layer_{i}"], x, attend_cached)
 
@@ -298,13 +329,68 @@ def decode_step_ragged(params, cfg: GPTConfig, cache: DecodeCache, token,
             new_v = new_v.at[i, bidx, hidx, wpos].set(
                 v[:, :, 0, :].astype(new_v.dtype)
             )
-            return _attend(q, new_k[i], new_v[i], pos_mask), None
+            return _attend(q, new_k[i].astype(q.dtype),
+                           new_v[i].astype(q.dtype), pos_mask), None
 
         x, _ = _block(cfg, p[f"layer_{i}"], x, attend_cached)
 
     logits = _lm_head(params, cfg, x)[:, 0]
     new_len = jnp.where(active, pos + 1, pos)
     return DecodeCache(k=new_k, v=new_v, length=new_len), logits
+
+
+def verify_step_ragged(params, cfg: GPTConfig, cache: DecodeCache, tokens,
+                       active=None):
+    """Multi-position cached step — the speculative-decoding VERIFY
+    program. ``tokens`` [B, n] are each row's next n tokens (position
+    ``length[b] + j`` for column j): all n K/V pairs are written, and the
+    logits after EVERY position come back in one dispatch, so a draft
+    model's n-1 proposals plus the current token are scored by the target
+    at the cost of one batched forward instead of n sequential ticks.
+
+    Query j attends to cache positions ``<= length[b] + j`` — its own
+    write lands first, exactly the single-step visibility rule applied
+    per column — so the n-row program computes THE SAME logits a scan of
+    n :func:`decode_step_ragged` calls would. Rejected speculation needs
+    no device rollback: ``cache.length`` comes back UNCHANGED (the caller
+    advances it by its accept count), and entries past the accepted
+    length are dead by the same masking that retires stale slots.
+    Returns ``(cache, logits [B, n, vocab])``.
+    """
+    b, n = tokens.shape
+    pos = cache.length  # [B]
+    if active is None:
+        active = jnp.ones((b,), bool)
+    positions = pos[:, None] + jnp.arange(n)[None, :]  # [B, n]
+    x = _embed(params, cfg, tokens, positions)
+    max_len = cache.k.shape[3]
+    num_heads = cache.k.shape[2]
+    visible = jnp.arange(max_len)[None, None, :] <= positions[:, :, None]
+    pos_mask = jnp.where(visible, 0.0, -1e9).astype(cfg.dtype)[:, None]
+    # masked rows (and rows past the cache extent) scatter out of bounds:
+    # the writes DROP, same contract as the single-step path
+    wpos = jnp.where(active[:, None], positions, max_len)  # [B, n]
+    bidx = jnp.arange(b)[:, None, None]       # [B, 1, 1]
+    hidx = jnp.arange(num_heads)[None, :, None]  # [1, H, 1]
+    widx = wpos[:, None, :]                   # [B, 1, n]
+
+    p = params["params"]
+    new_k, new_v = cache.k, cache.v
+
+    for i in range(cfg.num_layers):
+
+        def attend_cached(q, k, v, i=i):
+            nonlocal new_k, new_v
+            # k/v: [B, H, n, hd] — all n positions in one scatter
+            new_k = new_k.at[i, bidx, hidx, widx].set(k.astype(new_k.dtype))
+            new_v = new_v.at[i, bidx, hidx, widx].set(v.astype(new_v.dtype))
+            return _attend(q, new_k[i].astype(q.dtype),
+                           new_v[i].astype(q.dtype), pos_mask), None
+
+        x, _ = _block(cfg, p[f"layer_{i}"], x, attend_cached)
+
+    logits = _lm_head(params, cfg, x)  # [B, n, V]
+    return DecodeCache(k=new_k, v=new_v, length=pos), logits
 
 
 # -- paged KV cache -----------------------------------------------------------
@@ -332,17 +418,22 @@ def decode_step_ragged(params, cfg: GPTConfig, cache: DecodeCache, token,
 # placement (no code change on this side).
 
 
-def init_paged_pool(cfg: GPTConfig, num_blocks: int, page_size: int):
+def init_paged_pool(cfg: GPTConfig, num_blocks: int, page_size: int,
+                    cache_dtype=None):
     """The global block pool: K and V ``[L, num_blocks, H, page_size, hd]``.
     Block 0..num_blocks-1 are real; index ``num_blocks`` is the dropped-write
-    sentinel used by page tables."""
+    sentinel used by page tables. ``cache_dtype`` narrows pool storage
+    (bf16 = half the bytes per token in flight); compute stays at
+    ``cfg.dtype`` — reads upcast at the gather, writes downcast at the
+    scatter."""
     if num_blocks < 1:
         raise ValueError(f"need at least one block, got {num_blocks}")
     if page_size < 1:
         raise ValueError(f"page_size must be >= 1, got {page_size}")
     hd = cfg.hidden_size // cfg.num_heads
     shape = (cfg.num_layers, num_blocks, cfg.num_heads, page_size, hd)
-    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+    dtype = cfg.dtype if cache_dtype is None else cache_dtype
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
 def decode_step_paged(params, cfg: GPTConfig, pool_k, pool_v, page_table,
@@ -407,8 +498,10 @@ def decode_step_paged(params, cfg: GPTConfig, pool_k, pool_v, page_table,
             )
             # virtual view: [B, MP, H, P, hd] -> [B, H, MP*P, hd]
             kv_shape = (b, cfg.num_heads, t_virt, k.shape[-1])
-            k_virt = new_k[i][page_table].transpose(0, 2, 1, 3, 4).reshape(kv_shape)
-            v_virt = new_v[i][page_table].transpose(0, 2, 1, 3, 4).reshape(kv_shape)
+            k_virt = new_k[i][page_table].transpose(0, 2, 1, 3, 4) \
+                .reshape(kv_shape).astype(q.dtype)
+            v_virt = new_v[i][page_table].transpose(0, 2, 1, 3, 4) \
+                .reshape(kv_shape).astype(q.dtype)
             return _attend(q, k_virt, v_virt, pos_mask), None
 
         x, _ = _block(cfg, p[f"layer_{i}"], x, attend_cached)
@@ -416,6 +509,63 @@ def decode_step_paged(params, cfg: GPTConfig, pool_k, pool_v, page_table,
     logits = _lm_head(params, cfg, x)[:, 0]
     new_len = jnp.where(writable, pos + 1, pos)
     return new_k, new_v, new_len, logits
+
+
+def verify_step_paged(params, cfg: GPTConfig, pool_k, pool_v, page_table,
+                      lengths, tokens, active=None, limit=None):
+    """The paged twin of :func:`verify_step_ragged`: n positions per slot
+    written through the page table and scored in one dispatch. Positions
+    at or past each slot's write ``limit`` drop their writes (out-of-bounds
+    block index) exactly like the single-step clamp — the engine only ever
+    emits tokens whose prefix writes sit strictly inside the reservation,
+    so a dropped tail write can never corrupt an accepted token. Lengths
+    come back to the caller untouched (the engine advances by the accept
+    count); stale entries past it are masked like any retired slot's.
+    Returns ``(pool_k, pool_v, logits [B, n, vocab])``.
+    """
+    b, n = tokens.shape
+    num_blocks, page_size = pool_k.shape[1], pool_k.shape[3]
+    max_pages = page_table.shape[1]
+    t_virt = max_pages * page_size
+    pos = lengths  # [B]
+    if active is None:
+        active = jnp.ones((b,), bool)
+    positions = pos[:, None] + jnp.arange(n)[None, :]  # [B, n]
+    writable = jnp.broadcast_to(active[:, None], (b, n))
+    if limit is not None:
+        writable = writable & (positions < limit[:, None])
+    x = _embed(params, cfg, tokens, positions)
+    visible = jnp.arange(t_virt)[None, None, :] <= positions[:, :, None]
+    pos_mask = jnp.where(visible, 0.0, -1e9).astype(cfg.dtype)[:, None]
+    page = jnp.minimum(positions // page_size, max_pages - 1)  # [B, n]
+    blk = jnp.take_along_axis(page_table, page, axis=1)  # [B, n]
+    blk = jnp.where(writable, blk, num_blocks)  # dropped write when masked
+    off = positions % page_size  # [B, n]
+    bidx3 = blk[:, None, :]                        # [B, 1, n]
+    hidx3 = jnp.arange(cfg.num_heads)[None, :, None]  # [1, H, 1]
+    oidx3 = off[:, None, :]                        # [B, 1, n]
+
+    p = params["params"]
+    new_k, new_v = pool_k, pool_v
+
+    for i in range(cfg.num_layers):
+
+        def attend_cached(q, k, v, i=i):
+            nonlocal new_k, new_v
+            # k/v: [B, H, n, hd] — n page-table-translated scatters at once
+            new_k = new_k.at[i, bidx3, hidx3, oidx3].set(k.astype(new_k.dtype))
+            new_v = new_v.at[i, bidx3, hidx3, oidx3].set(v.astype(new_v.dtype))
+            kv_shape = (b, cfg.num_heads, t_virt, k.shape[-1])
+            k_virt = new_k[i][page_table].transpose(0, 2, 1, 3, 4) \
+                .reshape(kv_shape).astype(q.dtype)
+            v_virt = new_v[i][page_table].transpose(0, 2, 1, 3, 4) \
+                .reshape(kv_shape).astype(q.dtype)
+            return _attend(q, k_virt, v_virt, pos_mask), None
+
+        x, _ = _block(cfg, p[f"layer_{i}"], x, attend_cached)
+
+    logits = _lm_head(params, cfg, x)  # [B, n, V]
+    return new_k, new_v, logits
 
 
 def prefill_paged(params, cfg: GPTConfig, prompt_ids, prompt_lens,

@@ -16,7 +16,11 @@ a tick-heartbeat lease per replica, and detects four anomaly classes:
 - ``scale_storm`` — the dynamic loss scale halved ``storm_halvings``
   times inside one window (a run drowning in overflow, not riding one);
 - ``engine_fault`` — edge-triggered note from the serving fault handler,
-  so faults land in the same anomaly log operators read.
+  so faults land in the same anomaly log operators read;
+- ``degenerate_draft`` — a speculative engine's draft accept rate pinned
+  below the floor: speculation has become pure overhead (the per-replica
+  accept-rate feed comes from the serving loop, so one replica's stale
+  draft is visible even when the fleet average looks fine).
 
 Every NEW anomaly lands as a ``sentinel/anomaly`` span event, a flight
 recorder dump (``sentinel-<kind>``), and a registry counter bump, then
@@ -48,8 +52,10 @@ DEAD_REPLICA = "dead_replica"
 LATENCY_CLIFF = "latency_cliff"
 SCALE_STORM = "scale_storm"
 ENGINE_FAULT = "engine_fault"
+DEGENERATE_DRAFT = "degenerate_draft"
 
-KINDS = (STALL, DEAD_REPLICA, LATENCY_CLIFF, SCALE_STORM, ENGINE_FAULT)
+KINDS = (STALL, DEAD_REPLICA, LATENCY_CLIFF, SCALE_STORM, ENGINE_FAULT,
+         DEGENERATE_DRAFT)
 
 
 class RollingBaseline:
@@ -136,6 +142,9 @@ class Sentinel:
         cliff_consecutive: int = 2,
         storm_halvings: int = 3,
         storm_window: float = 64.0,
+        accept_floor: float = 0.1,
+        accept_warmup: int = 8,
+        accept_consecutive: int = 8,
         check_interval: Optional[float] = None,
     ):
         if clock is None:
@@ -151,6 +160,9 @@ class Sentinel:
         self.cliff_consecutive = int(cliff_consecutive)
         self.storm_halvings = int(storm_halvings)
         self.storm_window = float(storm_window)
+        self.accept_floor = float(accept_floor)
+        self.accept_warmup = int(accept_warmup)
+        self.accept_consecutive = int(accept_consecutive)
         self.check_interval = check_interval
         self._lock = threading.Lock()
         # replica key (None = the single engine) -> lease state
@@ -158,6 +170,8 @@ class Sentinel:
         self._tick_base: Dict[Optional[int], RollingBaseline] = {}
         self._cliff_run: Dict[Optional[int], int] = {}
         self._scales: deque = deque()  # (t, scale)
+        self._accept_n: Dict[Optional[int], int] = {}
+        self._accept_run: Dict[Optional[int], int] = {}
         self._remedies: Dict[str, List[Callable[[Anomaly], None]]] = {}
         self._firing: Dict[Tuple[str, Optional[int]], Anomaly] = {}
         self.anomalies: List[Anomaly] = []  # the log (fire + resolve)
@@ -303,6 +317,33 @@ class Sentinel:
                        {"halvings": halvings, "scale": float(scale)}, t)
         else:
             self._resolve(SCALE_STORM, None, t)
+
+    def observe_accept(self, rate: Optional[float],
+                       replica: Optional[int] = None,
+                       now: Optional[float] = None) -> None:
+        """Feed one speculative engine's recent draft accept fraction
+        (None = no speculation this tick, ignored). A draft whose
+        acceptances sit below ``accept_floor`` for ``accept_consecutive``
+        warmed samples fires ``degenerate_draft`` — speculation is then
+        pure overhead (k draft steps plus a k+1-wide verify per emitted
+        token), and an operator should shrink k, refresh the draft, or
+        turn speculation off on that replica. Recovery above the floor
+        auto-resolves the anomaly."""
+        if rate is None:
+            return
+        t = self.clock() if now is None else float(now)
+        with self._lock:
+            n = self._accept_n.get(replica, 0) + 1
+            self._accept_n[replica] = n
+            low = n > self.accept_warmup and float(rate) < self.accept_floor
+            run = self._accept_run.get(replica, 0) + 1 if low else 0
+            self._accept_run[replica] = run
+        if low and run >= self.accept_consecutive:
+            self._fire(DEGENERATE_DRAFT, replica,
+                       {"accept_rate": round(float(rate), 4),
+                        "floor": self.accept_floor}, t)
+        elif not low:
+            self._resolve(DEGENERATE_DRAFT, replica, t)
 
     def note_fault(self, error: str = "", replica: Optional[int] = None,
                    now: Optional[float] = None) -> None:

@@ -29,7 +29,12 @@ def recover_and_requeue(server):
 
     def remedy(anomaly):
         who = "" if anomaly.replica is None else f" replica {anomaly.replica}"
-        server.request_recover(f"sentinel:{anomaly.kind}{who}")
+        # the replica rides along so a free-running server routes the
+        # recovery to the ANOMALOUS replica's loop, not whichever loop
+        # polls first (the lockstep server recovers the whole engine and
+        # ignores it)
+        server.request_recover(f"sentinel:{anomaly.kind}{who}",
+                               replica=anomaly.replica)
 
     remedy.__name__ = "recover_and_requeue"
     return remedy

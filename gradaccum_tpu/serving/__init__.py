@@ -15,10 +15,22 @@ in flight while every shape stays static). Paged blocks are refcounted, so
 ``Engine(prefix_cache=True)`` lets requests with identical prompt prefixes
 map their page tables onto the SAME blocks (``PrefixCache`` hashes
 page-aligned prompt chunks at admission) and prefill only their unshared
-tails. Admission
+tails. ``Engine(speculate_k=k, draft_params=..., draft_cfg=...)`` cuts the
+per-token dispatch bill with SPECULATIVE DECODING: a shallow draft model
+(``models/gpt_decode.truncate_draft_params`` carves one from the target)
+proposes k tokens per slot per cycle and the target scores all k+1
+positions in one multi-position verify dispatch — greedy output is
+token-for-token identical to the plain tick at any accept rate, sampled
+mode preserves the target distribution via rejection sampling.
+``overlap_prefill=True`` enqueues admission prefill and the decode tick
+before any readback so the device rolls straight from one into the
+other; ``cache_dtype=jnp.bfloat16`` halves KV-pool (and draft-cache)
+bytes. Admission
 control with backpressure and deadlines lives in ``scheduler``; a threaded
-front-end plus a deterministic seeded simulation driver in ``server``;
-TTFT / throughput / occupancy telemetry in ``metrics``. Multi-chip spans
+front-end plus a deterministic seeded simulation driver in ``server``
+(``ServingServer(free_running=True)`` runs one loop thread per replica of
+a fleet); TTFT / throughput / occupancy / speculative-accept telemetry in
+``metrics``. Multi-chip spans
 two independent axes: ``Engine(mesh=...)`` tensor-shards one engine's
 compiled tick over a serving mesh (weights Megatron-style, the paged pool
 on its BLOCK axis), and ``ReplicatedEngine`` (``replicated``) places N

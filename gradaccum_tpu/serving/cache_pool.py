@@ -117,13 +117,19 @@ class _SlotLedger:
 
 
 class CachePool(_SlotLedger):
-    """Slot bookkeeping (host) + the pooled cache arrays (device)."""
+    """Slot bookkeeping (host) + the pooled cache arrays (device).
+    ``cache_dtype`` narrows K/V storage (bf16 = half the pool bytes);
+    compute stays at ``cfg.dtype`` — the decode programs upcast reads and
+    downcast writes."""
 
-    def __init__(self, cfg: GPTConfig, num_slots: int, max_len: int):
+    def __init__(self, cfg: GPTConfig, num_slots: int, max_len: int,
+                 cache_dtype=None):
         self._init_slots(num_slots)
-        cache = init_cache(cfg, num_slots, max_len)  # validates max_len
+        # validates max_len
+        cache = init_cache(cfg, num_slots, max_len, cache_dtype=cache_dtype)
         self.k = cache.k
         self.v = cache.v
+        self.cache_dtype = cache_dtype
         self.lengths = jnp.zeros((num_slots,), jnp.int32)
         self.max_len = max_len
 
@@ -237,7 +243,8 @@ class PagedCachePool(_SlotLedger):
 
     def __init__(self, cfg: GPTConfig, num_slots: int, max_len: int,
                  page_size: int, num_blocks: int,
-                 prefix_cache: Optional[PrefixCache] = None):
+                 prefix_cache: Optional[PrefixCache] = None,
+                 cache_dtype=None):
         self._init_slots(num_slots)
         if max_len % page_size:
             # keeps a slot's virtual axis exactly max_pages * page_size and
@@ -255,7 +262,9 @@ class PagedCachePool(_SlotLedger):
                 f"prefix cache page_size {prefix_cache.page_size} != pool "
                 f"page_size {page_size}"
             )
-        self.k, self.v = init_paged_pool(cfg, num_blocks, page_size)
+        self.k, self.v = init_paged_pool(cfg, num_blocks, page_size,
+                                         cache_dtype=cache_dtype)
+        self.cache_dtype = cache_dtype
         self.lengths = jnp.zeros((num_slots,), jnp.int32)
         self.max_len = max_len
         self.page_size = page_size

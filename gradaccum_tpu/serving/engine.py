@@ -37,11 +37,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from gradaccum_tpu.models.gpt import GPTConfig
 from gradaccum_tpu.models.gpt_decode import (
     DecodeCache,
+    _top_k_mask,
     decode_step_paged,
     decode_step_ragged,
+    init_cache,
     prefill,
     prefill_paged,
     sample_token,
+    verify_step_paged,
+    verify_step_ragged,
 )
 from gradaccum_tpu.obs import trace as obs_trace
 from gradaccum_tpu.resilience import faults
@@ -203,6 +207,249 @@ def _make_prefix_admit_fn(cfg: GPTConfig, temperature: float, top_k):
     return jax.jit(admit, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
 
 
+def _make_spec_tick_fn(cfg: GPTConfig, draft_cfg: GPTConfig,
+                       temperature: float, top_k, spec_k: int, paged: bool):
+    """ONE compiled speculative cycle: the draft proposes ``spec_k`` tokens
+    (a ``lax.scan`` over its own shallow cache — plus one extra write so an
+    all-accepted cycle leaves no hole at ``pos + k``), the target scores
+    all ``k+1`` positions in a single multi-position verify, and the accept
+    rule turns the two into up to ``k+1`` emitted tokens per slot — all in
+    one dispatch, so the host pays one program + one readback for what the
+    plain tick spreads over ``k+1`` dispatches.
+
+    Greedy (``temperature == 0``) accepts the longest prefix of draft
+    tokens matching the target's argmaxes and emits the target's argmax at
+    every accepted column INCLUDING the first mismatch — which is exactly
+    the token-for-token sequence the non-speculative engine emits, so
+    speculation changes throughput, never results (the spec parity gate).
+    Sampled mode runs Leviathan-style rejection sampling: draft token
+    ``d_j`` survives with probability ``min(1, p_t(d_j)/p_d(d_j))`` and the
+    first rejection resamples from ``max(p_t - p_d, 0)`` normalized (the
+    target's own distribution when every draft survives), so the EMITTED
+    distribution equals the target's — the draws differ from the
+    non-speculative stream, the distribution does not.
+
+    Rng discipline: every draw folds the per-request stream with
+    ``pos * (k+2) + column`` — ``pos`` strictly increases per cycle, so a
+    rejected column's redraw next cycle (same position, new conditioning)
+    never reuses a consumed key. Rejected positions need no device
+    rollback on EITHER cache: lengths advance only by the accept count and
+    mask everything past it, on the target pool and the draft cache alike.
+    """
+    kplus = spec_k + 1
+
+    def _mask(logits):
+        return _top_k_mask(logits, top_k) if top_k is not None else logits
+
+    def _keys(rngs, idx, salt):
+        return jax.vmap(
+            lambda r, i: jax.random.fold_in(jax.random.fold_in(r, i), salt)
+        )(rngs, idx)
+
+    def tick(params, draft_params, k, v, lengths, dk, dv, cur_tok, gen_count,
+             rngs, active, page_table=None, limit=None):
+        pos = lengths
+        base_idx = pos * (spec_k + 2)
+
+        def dstep(carry, j):
+            cache, tok = carry
+            cache, logits = decode_step_ragged(draft_params, draft_cfg,
+                                               cache, tok, active)
+            if temperature > 0:
+                keys = _keys(rngs, base_idx + j, 1)
+                masked = _mask(logits)
+                nxt = jax.vmap(
+                    lambda lg, key: jax.random.categorical(key,
+                                                           lg / temperature)
+                )(masked, keys)
+                ys = (nxt, jax.nn.softmax(
+                    masked.astype(jnp.float32) / temperature, axis=-1))
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+                ys = nxt
+            nxt = jnp.where(active, nxt.astype(jnp.int32), tok)
+            ys = (nxt, ys[1]) if temperature > 0 else nxt
+            return (cache, nxt), ys
+
+        dcache0 = DecodeCache(k=dk, v=dv, length=pos)
+        (dcache, last), ys = jax.lax.scan(dstep, (dcache0, cur_tok),
+                                          jnp.arange(spec_k))
+        drafts = ys[0] if temperature > 0 else ys  # [k, B]
+        # the proposal scan wrote positions pos..pos+k-1; write d_k's K/V
+        # too so an all-accepted cycle's draft cache has no hole at pos+k
+        dcache, _ = decode_step_ragged(draft_params, draft_cfg, dcache,
+                                       last, active)
+        d_bt = drafts.T  # [B, k]
+        tokens_in = jnp.concatenate([cur_tok[:, None], d_bt], axis=1)
+
+        if paged:
+            new_k, new_v, logits = verify_step_paged(
+                params, cfg, k, v, page_table, lengths, tokens_in, active,
+                limit)
+        else:
+            vcache, logits = verify_step_ragged(
+                params, cfg, DecodeCache(k=k, v=v, length=lengths),
+                tokens_in, active)
+            new_k, new_v = vcache.k, vcache.v
+
+        if temperature > 0:
+            p_t = jax.nn.softmax(
+                _mask(logits).astype(jnp.float32) / temperature, axis=-1)
+            p_d = jnp.moveaxis(ys[1], 0, 1)  # [B, k, V]
+            pt_d = jnp.take_along_axis(p_t[:, :spec_k], d_bt[..., None],
+                                       axis=-1)[..., 0]
+            pd_d = jnp.take_along_axis(p_d, d_bt[..., None], axis=-1)[..., 0]
+            gidx = base_idx[:, None] + jnp.arange(spec_k)[None, :]
+            us = jax.vmap(lambda r, idx: jax.vmap(
+                lambda i: jax.random.uniform(
+                    jax.random.fold_in(jax.random.fold_in(r, i), 2))
+            )(idx))(rngs, gidx)  # [B, k]
+            match = us * jnp.maximum(pd_d, 1e-20) <= pt_d
+            acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+            # residual at the first rejected column; padding the draft
+            # dists with p_t's column k makes the all-accepted bonus fall
+            # out of the same formula (residual 0 -> fall back to p_t)
+            p_d_ext = jnp.concatenate([p_d, p_t[:, spec_k:]], axis=1)
+            p_t_a = jnp.take_along_axis(p_t, acc[:, None, None],
+                                        axis=1)[:, 0]
+            p_d_a = jnp.take_along_axis(p_d_ext, acc[:, None, None],
+                                        axis=1)[:, 0]
+            resid = jnp.maximum(p_t_a - p_d_a, 0.0)
+            rs = resid.sum(-1, keepdims=True)
+            final_dist = jnp.where(rs > 0, resid / jnp.maximum(rs, 1e-20),
+                                   p_t_a)
+            fkeys = _keys(rngs, base_idx + acc, 3)
+            final = jax.vmap(
+                lambda d, key: jax.random.categorical(key, jnp.log(d))
+            )(final_dist, fkeys).astype(jnp.int32)
+            offs = jnp.arange(kplus)[None, :]
+            d_ext = jnp.concatenate([d_bt, final[:, None]], axis=1)
+            out = jnp.where(offs < acc[:, None], d_ext, final[:, None])
+            new_cur = final
+        else:
+            tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
+            match = tgt[:, :spec_k] == d_bt
+            acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+            out = tgt
+            new_cur = jnp.take_along_axis(tgt, acc[:, None], axis=1)[:, 0]
+
+        counts = jnp.where(active, acc + 1, 0).astype(jnp.int32)
+        new_len = pos + counts
+        if paged:
+            new_len = jnp.minimum(new_len, limit)
+        new_cur = jnp.where(active, new_cur, cur_tok)
+        return (new_k, new_v, new_len, dcache.k, dcache.v, new_cur,
+                gen_count + counts, out, counts)
+
+    if paged:
+        def tick_paged(params, draft_params, k, v, lengths, dk, dv, cur_tok,
+                       gen_count, rngs, active, page_table, limit):
+            return tick(params, draft_params, k, v, lengths, dk, dv,
+                        cur_tok, gen_count, rngs, active, page_table, limit)
+        return jax.jit(tick_paged, donate_argnums=(2, 3, 4, 5, 6, 7, 8))
+
+    def tick_fixed(params, draft_params, k, v, lengths, dk, dv, cur_tok,
+                   gen_count, rngs, active):
+        return tick(params, draft_params, k, v, lengths, dk, dv, cur_tok,
+                    gen_count, rngs, active)
+    return jax.jit(tick_fixed, donate_argnums=(2, 3, 4, 5, 6, 7, 8))
+
+
+def _make_spec_admit_fn(cfg: GPTConfig, draft_cfg: GPTConfig,
+                        temperature: float, top_k, max_len: int):
+    """Fixed-pool admission with a DRAFT prefill riding along: the same
+    ragged target prefill plus the shallow draft run over the same prompt
+    batch, both scattered into their pools in one dispatch — an admitted
+    request is speculation-ready the moment it is active."""
+
+    def admit(params, draft_params, k, v, lengths, dk, dv, cur_tok,
+              gen_count, rngs, ids, prompt_lens, slots, keys):
+        cache, logits = prefill(params, cfg, ids, max_len, lengths=prompt_lens)
+        dcache, _ = prefill(draft_params, draft_cfg, ids, max_len,
+                            lengths=prompt_lens)
+
+        def pick(lg, key):
+            return sample_token(lg, key, 0, temperature, top_k)
+
+        tok0 = jax.vmap(pick)(logits, keys).astype(jnp.int32)
+        k = k.at[:, slots].set(cache.k.astype(k.dtype))
+        v = v.at[:, slots].set(cache.v.astype(v.dtype))
+        dk = dk.at[:, slots].set(dcache.k.astype(dk.dtype))
+        dv = dv.at[:, slots].set(dcache.v.astype(dv.dtype))
+        lengths = lengths.at[slots].set(cache.length)
+        cur_tok = cur_tok.at[slots].set(tok0)
+        gen_count = gen_count.at[slots].set(1)
+        rngs = rngs.at[slots].set(keys)
+        return k, v, lengths, dk, dv, cur_tok, gen_count, rngs, tok0
+
+    return jax.jit(admit, donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
+
+
+def _make_spec_paged_admit_fn(cfg: GPTConfig, draft_cfg: GPTConfig,
+                              temperature: float, top_k, max_len: int):
+    """Paged admission + draft prefill: the target side is the page-chunk
+    scatter of :func:`_make_paged_admit_fn`; the draft cache stays a
+    fixed-slot layout (shallow × small — paging it would buy bytes the
+    draft doesn't have), so its prefill scatters per slot."""
+
+    def admit(params, draft_params, k, v, lengths, dk, dv, cur_tok,
+              gen_count, rngs, limit, ids, prompt_lens, slots, keys,
+              page_rows, limits):
+        k, v, logits = prefill_paged(params, cfg, ids, prompt_lens, k, v,
+                                     page_rows)
+        dcache, _ = prefill(draft_params, draft_cfg, ids, max_len,
+                            lengths=prompt_lens)
+
+        def pick(lg, key):
+            return sample_token(lg, key, 0, temperature, top_k)
+
+        tok0 = jax.vmap(pick)(logits, keys).astype(jnp.int32)
+        dk = dk.at[:, slots].set(dcache.k.astype(dk.dtype))
+        dv = dv.at[:, slots].set(dcache.v.astype(dv.dtype))
+        lengths = lengths.at[slots].set(prompt_lens)
+        cur_tok = cur_tok.at[slots].set(tok0)
+        gen_count = gen_count.at[slots].set(1)
+        rngs = rngs.at[slots].set(keys)
+        limit = limit.at[slots].set(limits)
+        return k, v, lengths, dk, dv, cur_tok, gen_count, rngs, limit, tok0
+
+    return jax.jit(admit, donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
+
+
+def _make_spec_prefix_admit_fn(cfg: GPTConfig, draft_cfg: GPTConfig,
+                               temperature: float, top_k, max_len: int):
+    """Prefix-sharing admission + draft prefill. The target prefills only
+    each row's unshared tail against pooled prefix K/V; the draft cache has
+    no prefix sharing (fixed layout, private per slot), so it prefills the
+    FULL prompt (``full_ids`` / ``full_lens``) — the draft is shallow, so
+    re-running the shared region costs a fraction of what the target
+    saved."""
+
+    def admit(params, draft_params, k, v, lengths, dk, dv, cur_tok,
+              gen_count, rngs, limit, ids, suffix_lens, start_lens, slots,
+              keys, page_rows, read_tables, limits, full_ids, full_lens):
+        k, v, logits = prefill_paged(params, cfg, ids, suffix_lens, k, v,
+                                     page_rows, start_lens=start_lens,
+                                     read_tables=read_tables)
+        dcache, _ = prefill(draft_params, draft_cfg, full_ids, max_len,
+                            lengths=full_lens)
+
+        def pick(lg, key):
+            return sample_token(lg, key, 0, temperature, top_k)
+
+        tok0 = jax.vmap(pick)(logits, keys).astype(jnp.int32)
+        dk = dk.at[:, slots].set(dcache.k.astype(dk.dtype))
+        dv = dv.at[:, slots].set(dcache.v.astype(dv.dtype))
+        lengths = lengths.at[slots].set(start_lens + suffix_lens)
+        cur_tok = cur_tok.at[slots].set(tok0)
+        gen_count = gen_count.at[slots].set(1)
+        rngs = rngs.at[slots].set(keys)
+        limit = limit.at[slots].set(limits)
+        return k, v, lengths, dk, dv, cur_tok, gen_count, rngs, limit, tok0
+
+    return jax.jit(admit, donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
+
+
 class Engine:
     """Multiplexes concurrent generation requests through one decode tick.
 
@@ -293,6 +540,11 @@ class Engine:
         replica_id: Optional[int] = None,
         id_start: int = 0,
         id_stride: int = 1,
+        speculate_k: int = 0,
+        draft_params=None,
+        draft_cfg: Optional[GPTConfig] = None,
+        cache_dtype=None,
+        overlap_prefill: bool = False,
     ):
         if top_k is not None and temperature <= 0:
             raise ValueError("top_k sampling needs temperature > 0 "
@@ -305,6 +557,26 @@ class Engine:
             raise ValueError("num_blocks needs page_size (paged mode)")
         if id_stride < 1:
             raise ValueError(f"id_stride must be >= 1, got {id_stride}")
+        if speculate_k < 0:
+            raise ValueError(f"speculate_k must be >= 0, got {speculate_k}")
+        if speculate_k > 0:
+            if draft_params is None or draft_cfg is None:
+                raise ValueError(
+                    "speculate_k needs draft_params and draft_cfg "
+                    "(models/gpt_decode.truncate_draft_params carves a "
+                    "draft from the target's own weights)"
+                )
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size} — the draft proposes target tokens"
+                )
+            if decode_block != 1 or decode_block_set is not None:
+                raise ValueError(
+                    "speculate_k already advances up to k+1 positions per "
+                    "dispatch (it IS the block knob); use decode_block=1 "
+                    "without decode_block_set"
+                )
         self.params = params
         self.cfg = cfg
         self.max_len = max_len
@@ -312,6 +584,11 @@ class Engine:
         self.top_k = None if top_k is None else int(top_k)
         self.paged = page_size is not None
         self.page_size = None if page_size is None else int(page_size)
+        self.speculate_k = int(speculate_k)
+        self.draft_cfg = draft_cfg if self.speculate_k else None
+        self.draft_params = draft_params if self.speculate_k else None
+        self.cache_dtype = cache_dtype
+        self.overlap_prefill = bool(overlap_prefill)
         # truthiness is not enough: an EMPTY PrefixCache instance is falsy
         # (__len__ == 0) but is still an explicit request for sharing
         wants_prefix = bool(prefix_cache) or isinstance(prefix_cache,
@@ -330,11 +607,23 @@ class Engine:
             self.num_blocks = int(num_blocks)
             self.pool = PagedCachePool(cfg, num_slots, max_len,
                                        self.page_size, self.num_blocks,
-                                       prefix_cache=self.prefix_cache)
+                                       prefix_cache=self.prefix_cache,
+                                       cache_dtype=cache_dtype)
         else:
             self.prefix_cache = None
             self.num_blocks = None
-            self.pool = CachePool(cfg, num_slots, max_len)
+            self.pool = CachePool(cfg, num_slots, max_len,
+                                  cache_dtype=cache_dtype)
+        # the draft model's OWN KV cache: fixed-slot layout regardless of
+        # the target pool kind (shallow × small — paging it would add page
+        # bookkeeping for bytes the draft doesn't have), narrowed by the
+        # same cache_dtype knob
+        if self.speculate_k:
+            dcache = init_cache(draft_cfg, num_slots, max_len,
+                                cache_dtype=cache_dtype)
+            self._draft_k, self._draft_v = dcache.k, dcache.v
+        else:
+            self._draft_k = self._draft_v = None
         self.mesh = mesh
         self.replica_id = None if replica_id is None else int(replica_id)
         if mesh is not None:
@@ -363,6 +652,19 @@ class Engine:
                     f"model axis ({tp}) — the paged pool shards its BLOCK "
                     "axis"
                 )
+            if self.speculate_k:
+                for what, dim in (("num_heads", draft_cfg.num_heads),
+                                  ("intermediate_size",
+                                   draft_cfg.intermediate_size),
+                                  ("vocab_size", draft_cfg.vocab_size)):
+                    if dim % tp:
+                        raise ValueError(
+                            f"draft_cfg.{what}={dim} not divisible by the "
+                            f"model axis ({tp}) — the draft shards like "
+                            "the target"
+                        )
+                self.draft_params = shard_params(draft_params, mesh,
+                                                 gpt_tp_rules())
             self.params = shard_params(params, mesh, gpt_tp_rules())
         # replica/mesh attribution spread into spans and flight dumps; {}
         # for a plain single-chip engine, so the obs determinism gate and
@@ -439,6 +741,14 @@ class Engine:
             b: make_tick(cfg, self.temperature, self.top_k, b)
             for b in self.decode_block_set
         }
+        # speculation replaces the decode tick with ONE draft+verify+accept
+        # program; _tick_fns stays as the speculate_k=0 fallback (and is
+        # never traced in spec mode, so the compile bound is unchanged)
+        self._spec_tick_fn = None
+        if self.speculate_k:
+            self._spec_tick_fn = _make_spec_tick_fn(
+                cfg, draft_cfg, self.temperature, self.top_k,
+                self.speculate_k, self.paged)
         # prefix engines carry BOTH paged admit programs: the suffix-aware
         # one for batches with at least one hit, and the plain one so an
         # all-miss batch (the steady state at low hit rates) never pays the
@@ -446,15 +756,26 @@ class Engine:
         # families, still traffic-independent
         self._prefix_admit_fn = None
         if self.paged and self.prefix_cache is not None:
-            self._prefix_admit_fn = _make_prefix_admit_fn(
-                cfg, self.temperature, self.top_k
+            self._prefix_admit_fn = (
+                _make_spec_prefix_admit_fn(cfg, draft_cfg, self.temperature,
+                                           self.top_k, max_len)
+                if self.speculate_k else
+                _make_prefix_admit_fn(cfg, self.temperature, self.top_k)
             )
         if self.paged:
-            self._admit_fn = _make_paged_admit_fn(cfg, self.temperature,
-                                                  self.top_k)
+            self._admit_fn = (
+                _make_spec_paged_admit_fn(cfg, draft_cfg, self.temperature,
+                                          self.top_k, max_len)
+                if self.speculate_k else
+                _make_paged_admit_fn(cfg, self.temperature, self.top_k)
+            )
         else:
-            self._admit_fn = _make_admit_fn(cfg, self.temperature, self.top_k,
-                                            max_len)
+            self._admit_fn = (
+                _make_spec_admit_fn(cfg, draft_cfg, self.temperature,
+                                    self.top_k, max_len)
+                if self.speculate_k else
+                _make_admit_fn(cfg, self.temperature, self.top_k, max_len)
+            )
         self._tick = 0
         self._next_id = int(id_start)
         self._id_stride = int(id_stride)
@@ -490,6 +811,12 @@ class Engine:
         self._gen = jax.device_put(self._gen, rep)
         self._rngs = jax.device_put(self._rngs, rep)
         self._limit = jax.device_put(self._limit, rep)
+        if self.speculate_k:
+            # the draft cache is fixed layout [dL, S, HEADS, T, hd]: shard
+            # the head axis, same as the fixed target pool
+            dkv = NamedSharding(mesh, P(None, None, MODEL_AXIS))
+            self._draft_k = jax.device_put(self._draft_k, dkv)
+            self._draft_v = jax.device_put(self._draft_v, dkv)
 
     # -- introspection ----------------------------------------------------
 
@@ -521,8 +848,12 @@ class Engine:
         """Distinct decode-tick programs compiled so far. The engine-parity
         gate asserts this is exactly 1 after any amount of traffic (one per
         block size in ``decode_block_set`` when dynamic control is on —
-        bounded by the set, never by traffic)."""
-        return sum(f._cache_size() for f in self._tick_fns.values())
+        bounded by the set, never by traffic; a speculative engine's one
+        draft+verify program counts here too and obeys the same bound)."""
+        count = sum(f._cache_size() for f in self._tick_fns.values())
+        if self._spec_tick_fn is not None:
+            count += self._spec_tick_fn._cache_size()
+        return count
 
     def prefill_compile_count(self) -> int:
         """Distinct (batch, bucketed-length) prefill programs — bounded by
@@ -552,6 +883,12 @@ class Engine:
                      else {n: int(self.mesh.shape[n])
                            for n in self.mesh.axis_names}),
             "replica_id": self.replica_id,
+            "speculate_k": self.speculate_k,
+            "draft_num_layers": (self.draft_cfg.num_layers
+                                 if self.speculate_k else None),
+            "cache_dtype": (None if self.cache_dtype is None
+                            else jnp.dtype(self.cache_dtype).name),
+            "overlap_prefill": self.overlap_prefill,
         }
 
     # -- request intake ---------------------------------------------------
@@ -675,9 +1012,12 @@ class Engine:
 
     @property
     def _token_bytes(self) -> int:
-        """Pool bytes per cache position (K and V, all layers)."""
+        """Pool bytes per cache position (K and V, all layers) at the
+        pool's STORAGE dtype — a bf16 cache charges half per token."""
+        dtype = (self.cfg.dtype if self.cache_dtype is None
+                 else self.cache_dtype)
         return 2 * self.cfg.num_layers * self.cfg.hidden_size * \
-            jnp.dtype(self.cfg.dtype).itemsize
+            jnp.dtype(dtype).itemsize
 
     def step(self) -> StepEvents:
         """One engine tick: expire → admit/prefill → fused decode.
@@ -708,6 +1048,12 @@ class Engine:
         for req in self.scheduler.expire(t):
             self.status[req.request_id] = "timeout"
             finished.append((req.request_id, "timeout"))
+            # a deadline expiry is a TERMINAL queue-wait observation: the
+            # request waited this long and never got a slot. Skipping it
+            # (as record_admit alone would) undercounts the queue-wait SLO
+            # series exactly when waiting is worst — e.g. the off-phase
+            # ticks of Scheduler(prefill_interval > 1)
+            self.metrics.record_expired(req.request_id)
             self.metrics.record_finish(req.request_id, "timeout")
             # pop unconditionally: the tracer can be swapped/disabled
             # mid-flight, and a skipped pop would leak the rid forever
@@ -740,73 +1086,107 @@ class Engine:
                 return True
 
         reqs = self.scheduler.admit(self.pool.free_count, t, fits=fits)
-        if reqs:
-            if tr.enabled:
-                with tr.span("serve/prefill", cat="serving", tick=t,
-                             batch=len(reqs)):
-                    self._admit(reqs, emitted, finished, admitted)
-            else:
-                self._admit(reqs, emitted, finished, admitted)
-        if self.scheduler.depth > 0 and self.pool.free_count == 0:
-            self.scheduler.record_stall("no_free_slots")
-
-        # seeded crash point between admission and the decode dispatch —
-        # requests in slots at this instant are what recover() hands back
-        faults.fire(faults.MID_DECODE_TICK, t)
-
         block = self._pick_block()
-        active_now = self._active.copy()
-        if active_now.any():
-            if tr.enabled:
+        if self.overlap_prefill:
+            # OVERLAPPED admission: BOTH programs are enqueued before any
+            # readback. The prefill dispatches, the freshly claimed slots
+            # activate (host flags — the decode program picks its inputs
+            # up from the admit program's device outputs), the decode
+            # dispatches behind it, and only then does the host read
+            # results back. The device therefore rolls from prefill
+            # straight into decode while the host is still emitting the
+            # admission batch's first tokens — in lockstep mode that gap
+            # is device idle time, the "stolen tick" admission charges
+            # every running stream. Tick-for-tick token content is
+            # IDENTICAL to lockstep (admitted slots join the same tick's
+            # decode, same as ever); only host/device pipelining changes.
+            astate = None
+            if reqs:
+                if tr.enabled:
+                    with tr.span("serve/prefill", cat="serving", tick=t,
+                                 batch=len(reqs)):
+                        astate = self._admit_dispatch(reqs)
+                else:
+                    astate = self._admit_dispatch(reqs)
+                areqs, aslots, _ = astate
+                for slot, req in zip(aslots, areqs):
+                    self._active[slot] = True
+                    self.status[req.request_id] = "running"
+                    admitted.append(req.request_id)
+            if self.scheduler.depth > 0 and self.pool.free_count == 0:
+                self.scheduler.record_stall("no_free_slots")
+            active_now = self._active.copy()
+            dspan = None
+            if active_now.any() and tr.enabled:
                 decode_args = dict(block=block, active=int(active_now.sum()))
+                if self.speculate_k:
+                    decode_args["speculate_k"] = self.speculate_k
                 if self.paged:
                     decode_args["free_blocks"] = self.pool.free_blocks
-                decode_span = tr.span("serve/decode", cat="serving",
-                                      tick=t, **decode_args)
-            else:
-                decode_span = obs_trace.NULL.span("")
-            # a with-block, not manual __enter__/__exit__: a decode-path
-            # exception must still land this span (error-tagged) in the
-            # ring, or the flight dump for that exact failure loses it
-            with decode_span:
-                args = (
-                    self.params, self.pool.k, self.pool.v, self.pool.lengths,
-                    self._cur_tok, self._gen, self._rngs,
-                    jnp.asarray(active_now),
-                )
-                if self.paged:
-                    # grow page tables BEFORE the dispatch to this tick's
-                    # worst-case end position (never past the write limit, so
-                    # the admission-time reservation always covers it)
-                    for slot in np.nonzero(active_now)[0]:
-                        self.pool.alloc_to(
-                            int(slot),
-                            min(self._slot_len[slot] + block,
-                                self._slot_limit[slot]),
-                        )
-                    out = self._tick_fns[block](
-                        *args, self.pool.page_table_device(), self._limit
-                    )
+                # held open across dispatch AND the readback (which lands
+                # after the admission finish under async dispatch): a
+                # decode-path exception surfacing anywhere in this tail
+                # must still close the span error-tagged into the ring,
+                # same invariant the lockstep branch keeps with its
+                # with-block
+                dspan = tr.span("serve/decode", cat="serving", tick=t,
+                                **decode_args)
+                dspan.__enter__()
+            try:
+                dstate = (self._decode_dispatch(active_now, block)
+                          if active_now.any() else None)
+                # the overlapped twin of the crash point below: both
+                # dispatches are in flight, nothing read back — recover()
+                # hands back every request in a slot, running and freshly
+                # admitted alike
+                faults.fire(faults.MID_DECODE_TICK, t)
+                if astate is not None:
+                    self._admit_finish(astate, emitted, finished, admitted,
+                                       activate=False)
+                if dstate is not None:
+                    self._decode_finish(dstate, emitted, finished)
+            except BaseException as e:
+                if dspan is not None:
+                    dspan.__exit__(type(e), e, e.__traceback__)
+                raise
+            if dspan is not None:
+                dspan.__exit__(None, None, None)
+        else:
+            if reqs:
+                if tr.enabled:
+                    with tr.span("serve/prefill", cat="serving", tick=t,
+                                 batch=len(reqs)):
+                        self._admit(reqs, emitted, finished, admitted)
                 else:
-                    out = self._tick_fns[block](*args)
-                k, v, lengths, nxt, gen, toks = out
-                self.pool.set_arrays(k, v, lengths)
-                self._cur_tok, self._gen = nxt, gen
-                # host length mirror: paged writes clamp at the slot limit,
-                # fixed ones at max_len (out-of-bounds scatter drop)
-                self._slot_len[active_now] = np.minimum(
-                    self._slot_len[active_now] + block,
-                    self._slot_limit[active_now]
-                    if self.paged else self.max_len,
-                )
-                toks_host = np.asarray(jax.device_get(toks))  # [block, slots]
-                for d in range(toks_host.shape[0]):
-                    for slot in np.nonzero(active_now)[0]:
-                        req = self._slot_req[slot]
-                        if req is None:  # retired earlier in this block
-                            continue
-                        self._emit(int(slot), req, int(toks_host[d, slot]),
-                                   emitted, finished, first=False)
+                    self._admit(reqs, emitted, finished, admitted)
+            if self.scheduler.depth > 0 and self.pool.free_count == 0:
+                self.scheduler.record_stall("no_free_slots")
+
+            # seeded crash point between admission and the decode dispatch —
+            # requests in slots at this instant are what recover() hands back
+            faults.fire(faults.MID_DECODE_TICK, t)
+
+            active_now = self._active.copy()
+            if active_now.any():
+                if tr.enabled:
+                    decode_args = dict(block=block,
+                                       active=int(active_now.sum()))
+                    if self.speculate_k:
+                        decode_args["speculate_k"] = self.speculate_k
+                    if self.paged:
+                        decode_args["free_blocks"] = self.pool.free_blocks
+                    decode_span = tr.span("serve/decode", cat="serving",
+                                          tick=t, **decode_args)
+                else:
+                    decode_span = obs_trace.NULL.span("")
+                # a with-block, not manual __enter__/__exit__: a decode-path
+                # exception must still land this span (error-tagged) in the
+                # ring, or the flight dump for that exact failure loses it
+                with decode_span:
+                    self._decode_finish(
+                        self._decode_dispatch(active_now, block),
+                        emitted, finished,
+                    )
 
         gauges = dict(
             tokens_in_flight=int(self._slot_len[self._active].sum()),
@@ -832,6 +1212,119 @@ class Engine:
                                  self.pool.num_slots, **gauges)
         self._tick = t + 1
         return StepEvents(emitted, finished, admitted, t)
+
+    def _decode_dispatch(self, active_now, block: int):
+        """Enqueue this tick's decode program — the plain block-scan or the
+        speculative draft+verify cycle — and store the updated device
+        arrays. Pure dispatch: nothing here blocks on the device, so the
+        overlapped path can enqueue the admission prefill behind it before
+        any readback. Returns the state :meth:`_decode_finish` reads back."""
+        if self.speculate_k:
+            if self.paged:
+                # worst case this cycle accepts all k drafts + the bonus
+                # token; grow page tables to that end position (clamped at
+                # the write limit, so the reservation always covers it)
+                adv = self.speculate_k + 1
+                for slot in np.nonzero(active_now)[0]:
+                    self.pool.alloc_to(
+                        int(slot),
+                        min(self._slot_len[slot] + adv,
+                            self._slot_limit[slot]),
+                    )
+                out = self._spec_tick_fn(
+                    self.params, self.draft_params, self.pool.k, self.pool.v,
+                    self.pool.lengths, self._draft_k, self._draft_v,
+                    self._cur_tok, self._gen, self._rngs,
+                    jnp.asarray(active_now), self.pool.page_table_device(),
+                    self._limit,
+                )
+            else:
+                out = self._spec_tick_fn(
+                    self.params, self.draft_params, self.pool.k, self.pool.v,
+                    self.pool.lengths, self._draft_k, self._draft_v,
+                    self._cur_tok, self._gen, self._rngs,
+                    jnp.asarray(active_now),
+                )
+            (k, v, lengths, dk, dv, nxt, gen, toks, counts) = out
+            self.pool.set_arrays(k, v, lengths)
+            self._draft_k, self._draft_v = dk, dv
+            self._cur_tok, self._gen = nxt, gen
+            # the host length mirror advances at finish time: unlike the
+            # plain block, the advance is the (data-dependent) accept count
+            return ("spec", active_now, toks, counts)
+        args = (
+            self.params, self.pool.k, self.pool.v, self.pool.lengths,
+            self._cur_tok, self._gen, self._rngs,
+            jnp.asarray(active_now),
+        )
+        if self.paged:
+            # grow page tables BEFORE the dispatch to this tick's
+            # worst-case end position (never past the write limit, so
+            # the admission-time reservation always covers it)
+            for slot in np.nonzero(active_now)[0]:
+                self.pool.alloc_to(
+                    int(slot),
+                    min(self._slot_len[slot] + block,
+                        self._slot_limit[slot]),
+                )
+            out = self._tick_fns[block](
+                *args, self.pool.page_table_device(), self._limit
+            )
+        else:
+            out = self._tick_fns[block](*args)
+        k, v, lengths, nxt, gen, toks = out
+        self.pool.set_arrays(k, v, lengths)
+        self._cur_tok, self._gen = nxt, gen
+        # host length mirror: paged writes clamp at the slot limit,
+        # fixed ones at max_len (out-of-bounds scatter drop)
+        self._slot_len[active_now] = np.minimum(
+            self._slot_len[active_now] + block,
+            self._slot_limit[active_now]
+            if self.paged else self.max_len,
+        )
+        return ("plain", active_now, toks, None)
+
+    def _decode_finish(self, state, emitted, finished) -> None:
+        """Read this tick's tokens back and emit them. The speculative path
+        emits RAGGED per-slot runs — each slot streams exactly its accept
+        count + 1 tokens this cycle (host-side discard handles eos and
+        budget retirement mid-run, same as the plain block path)."""
+        kind, active_now, toks, counts = state
+        if kind == "spec":
+            # one transfer for both arrays: the readback IS the tick's
+            # host<->device sync point, so don't pay it twice
+            toks_host, counts_host = map(
+                np.asarray, jax.device_get((toks, counts)))
+            # toks_host [S, k+1], counts_host [S]
+            slots_np = np.nonzero(active_now)[0]
+            self.metrics.record_speculation(
+                proposed=int(self.speculate_k * len(slots_np)),
+                accepted=int(np.maximum(
+                    counts_host[slots_np] - 1, 0).sum()),
+            )
+            self._slot_len[active_now] = np.minimum(
+                self._slot_len[active_now] + counts_host[active_now],
+                self._slot_limit[active_now]
+                if self.paged else self.max_len,
+            )
+            for d in range(self.speculate_k + 1):
+                for slot in slots_np:
+                    if d >= counts_host[slot]:
+                        continue  # rejected speculation: never emitted
+                    req = self._slot_req[slot]
+                    if req is None:  # retired earlier in this cycle
+                        continue
+                    self._emit(int(slot), req, int(toks_host[slot, d]),
+                               emitted, finished, first=False)
+            return
+        toks_host = np.asarray(jax.device_get(toks))  # [block, slots]
+        for d in range(toks_host.shape[0]):
+            for slot in np.nonzero(active_now)[0]:
+                req = self._slot_req[slot]
+                if req is None:  # retired earlier in this block
+                    continue
+                self._emit(int(slot), req, int(toks_host[d, slot]),
+                           emitted, finished, first=False)
 
     def pop_result(self, request_id: int) -> Tuple[List[int], str]:
         """Remove and return ``(tokens, status)`` for a finished (or
@@ -914,8 +1407,12 @@ class Engine:
                 tr.complete("req/decode", ts0, cat="request",
                             rid=req.request_id, outcome="error",
                             **self._obs_args)
-        device_arrays = (self.pool.k, self.pool.v, self.pool.lengths,
-                         self._cur_tok, self._gen, self._rngs, self._limit)
+        device_arrays = [self.pool.k, self.pool.v, self.pool.lengths,
+                         self._cur_tok, self._gen, self._rngs, self._limit]
+        if self.speculate_k:
+            # a fault mid-spec-tick can strand the draft cache half-written
+            # (or donated-consumed) — it lives and dies with the pool
+            device_arrays += [self._draft_k, self._draft_v]
         if any(getattr(a, "is_deleted", lambda: False)() for a in device_arrays):
             num_slots = self.pool.num_slots
             if self.paged:
@@ -926,14 +1423,20 @@ class Engine:
                     self.prefix_cache.clear()
                 self.pool = PagedCachePool(self.cfg, num_slots, self.max_len,
                                            self.page_size, self.num_blocks,
-                                           prefix_cache=self.prefix_cache)
+                                           prefix_cache=self.prefix_cache,
+                                           cache_dtype=self.cache_dtype)
             else:
-                self.pool = CachePool(self.cfg, num_slots, self.max_len)
+                self.pool = CachePool(self.cfg, num_slots, self.max_len,
+                                      cache_dtype=self.cache_dtype)
             key0 = jax.random.PRNGKey(0)
             self._cur_tok = jnp.zeros((num_slots,), jnp.int32)
             self._gen = jnp.zeros((num_slots,), jnp.int32)
             self._rngs = jnp.zeros((num_slots,) + key0.shape, key0.dtype)
             self._limit = jnp.zeros((num_slots,), jnp.int32)
+            if self.speculate_k:
+                dcache = init_cache(self.draft_cfg, num_slots, self.max_len,
+                                    cache_dtype=self.cache_dtype)
+                self._draft_k, self._draft_v = dcache.k, dcache.v
             self._slot_len[:] = 0
             self._slot_limit[:] = 0
             if self.mesh is not None:
@@ -968,6 +1471,16 @@ class Engine:
         return min(b, self.max_len)
 
     def _admit(self, reqs, emitted, finished, admitted) -> None:
+        self._admit_finish(self._admit_dispatch(reqs), emitted, finished,
+                           admitted)
+
+    def _admit_dispatch(self, reqs):
+        """Pop-side admission: slot claim, reservation/page bookkeeping,
+        and the prefill dispatch — everything except the first-token
+        readback, so the overlapped path can enqueue it behind the decode
+        dispatch without blocking. Queue-wait metrics land HERE, at the
+        admission pop itself, so they are recorded whatever the interval
+        phase or overlap mode does to the rest of the tick."""
         tr = self.tracer
         enabled = tr.enabled
         now = tr.now() if enabled else 0.0
@@ -1047,18 +1560,34 @@ class Engine:
                 limits[i] = budget
                 self._slot_len[slot] = r.prompt.size
                 self._slot_limit[slot] = budget
-            args = (
-                self.params, self.pool.k, self.pool.v, self.pool.lengths,
-                self._cur_tok, self._gen, self._rngs, self._limit,
-                jnp.asarray(ids), jnp.asarray(lens),
-            )
+            spec = self.speculate_k > 0
+            if spec:
+                head = (self.params, self.draft_params, self.pool.k,
+                        self.pool.v, self.pool.lengths, self._draft_k,
+                        self._draft_v, self._cur_tok, self._gen, self._rngs,
+                        self._limit)
+            else:
+                head = (self.params, self.pool.k, self.pool.v,
+                        self.pool.lengths, self._cur_tok, self._gen,
+                        self._rngs, self._limit)
+            args = head + (jnp.asarray(ids), jnp.asarray(lens))
             if prefix and starts.any():
-                out = self._prefix_admit_fn(
-                    *args, jnp.asarray(starts),
-                    jnp.asarray(slots, jnp.int32), keys,
-                    jnp.asarray(page_rows), jnp.asarray(read_tables),
-                    jnp.asarray(limits),
-                )
+                tail = (jnp.asarray(starts), jnp.asarray(slots, jnp.int32),
+                        keys, jnp.asarray(page_rows),
+                        jnp.asarray(read_tables), jnp.asarray(limits))
+                if spec:
+                    # the draft prefills the FULL prompt: its fixed cache
+                    # has no shared blocks to lean on (the target's suffix
+                    # buffers cover only the unshared tail)
+                    s0f = self._bucket_len(max(r.prompt.size for r in reqs))
+                    full_ids = np.zeros((len(reqs), s0f), np.int32)
+                    full_lens = np.zeros((len(reqs),), np.int32)
+                    for i, r in enumerate(reqs):
+                        full_ids[i, s0f - r.prompt.size:] = r.prompt
+                        full_lens[i] = r.prompt.size
+                    tail = tail + (jnp.asarray(full_ids),
+                                   jnp.asarray(full_lens))
+                out = self._prefix_admit_fn(*args, *tail)
             else:
                 # all-miss batch (or prefix off): the plain paged program —
                 # no point gathering a prefix every row masks out
@@ -1066,8 +1595,12 @@ class Engine:
                     *args, jnp.asarray(slots, jnp.int32), keys,
                     jnp.asarray(page_rows), jnp.asarray(limits),
                 )
-            (k, v, lengths, self._cur_tok, self._gen, self._rngs,
-             self._limit, tok0) = out
+            if spec:
+                (k, v, lengths, self._draft_k, self._draft_v, self._cur_tok,
+                 self._gen, self._rngs, self._limit, tok0) = out
+            else:
+                (k, v, lengths, self._cur_tok, self._gen, self._rngs,
+                 self._limit, tok0) = out
             if prefix:
                 # index this batch's freshly written full-page chunks for
                 # FUTURE admissions (the entries these requests matched are
@@ -1083,13 +1616,25 @@ class Engine:
         else:
             for slot, r in zip(slots, reqs):
                 self._slot_len[slot] = r.prompt.size
-            out = self._admit_fn(
-                self.params, self.pool.k, self.pool.v, self.pool.lengths,
-                self._cur_tok, self._gen, self._rngs,
-                jnp.asarray(ids), jnp.asarray(lens),
-                jnp.asarray(slots, jnp.int32), keys,
-            )
-            k, v, lengths, self._cur_tok, self._gen, self._rngs, tok0 = out
+            if self.speculate_k:
+                out = self._admit_fn(
+                    self.params, self.draft_params, self.pool.k, self.pool.v,
+                    self.pool.lengths, self._draft_k, self._draft_v,
+                    self._cur_tok, self._gen, self._rngs,
+                    jnp.asarray(ids), jnp.asarray(lens),
+                    jnp.asarray(slots, jnp.int32), keys,
+                )
+                (k, v, lengths, self._draft_k, self._draft_v, self._cur_tok,
+                 self._gen, self._rngs, tok0) = out
+            else:
+                out = self._admit_fn(
+                    self.params, self.pool.k, self.pool.v, self.pool.lengths,
+                    self._cur_tok, self._gen, self._rngs,
+                    jnp.asarray(ids), jnp.asarray(lens),
+                    jnp.asarray(slots, jnp.int32), keys,
+                )
+                (k, v, lengths, self._cur_tok, self._gen, self._rngs,
+                 tok0) = out
         for i, r in enumerate(reqs):
             skipped = shared_tok.get(r.request_id, 0)
             # hit-rate denominator: only admissions that COULD have hit —
@@ -1108,11 +1653,25 @@ class Engine:
                          skipped_tokens=int(skipped),
                          shared_blocks=int(n_shared), **self._obs_args)
         self.pool.set_arrays(k, v, lengths)
+        return (reqs, slots, tok0)
+
+    def _admit_finish(self, state, emitted, finished, admitted,
+                      activate: bool = True) -> None:
+        """Read back the admission batch's first tokens and emit them —
+        the only admission step that blocks on the device. The overlapped
+        path activates slots itself (before the decode dispatch, so the
+        batch joins this tick's decode exactly like lockstep) and passes
+        ``activate=False``; a request retired here (eos on its first
+        token, max_new 1) releases its slot and the in-flight decode's
+        writes for it land in freed-but-masked state, same as any retired
+        slot's tail."""
+        reqs, slots, tok0 = state
         tok0_host = np.asarray(jax.device_get(tok0))
         for slot, req, tok in zip(slots, reqs, tok0_host):
-            self._active[slot] = True
-            self.status[req.request_id] = "running"
-            admitted.append(req.request_id)
+            if activate:
+                self._active[slot] = True
+                self.status[req.request_id] = "running"
+                admitted.append(req.request_id)
             self._emit(slot, req, int(tok), emitted, finished, first=True)
 
     def _emit(self, slot: int, req: Request, token: int,

@@ -104,6 +104,18 @@ class ServingMetrics:
             "serving/prefill_tokens_computed_total", labels=self._labels)
         self._c_prefill_skipped = reg.counter(
             "serving/prefill_tokens_skipped_total", labels=self._labels)
+        # speculative decoding: draft proposals vs target acceptances
+        # (cumulative counters for /metrics scrapes, a windowed per-tick
+        # fraction for the sentinel's degenerate-draft check)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self._c_spec_proposed = reg.counter("serving/spec_proposed_total",
+                                            labels=self._labels)
+        self._c_spec_accepted = reg.counter("serving/spec_accepted_total",
+                                            labels=self._labels)
+        self._g_spec_accept = reg.gauge("serving/spec_accept_rate",
+                                        labels=self._labels)
+        self._spec_window = LatencySeries(window=64)  # per-tick accept frac
 
     # -- per-request lifecycle -------------------------------------------
 
@@ -120,9 +132,49 @@ class ServingMetrics:
     def record_admit(self, request_id: int) -> None:
         """The request left the queue for a slot: its queue wait (submit →
         admission, in clock units) lands in the windowed series the
-        queue-wait SLO reads."""
+        queue-wait SLO reads. The engine calls this at the admission POP
+        itself — whatever ``Scheduler(prefill_interval)`` phase or
+        prefill-overlap mode the tick runs under — so every admitted
+        request contributes its full wait exactly once."""
         if request_id in self._submit_t:
             self.queue_wait.add(self.clock() - self._submit_t[request_id])
+
+    def record_expired(self, request_id: int) -> None:
+        """A deadline expiry is a TERMINAL queue-wait observation: the
+        request waited this long and never got a slot. Without it the
+        queue-wait series only sees the (shorter) waits of requests that
+        DID get admitted — undercounting waiting exactly when admission is
+        starved, e.g. the off-phase ticks of prefill_interval > 1. Same
+        observation rule as admission, by construction."""
+        self.record_admit(request_id)
+
+    def record_speculation(self, proposed: int, accepted: int) -> None:
+        """One speculative cycle's fleet-wide bill: ``proposed`` draft
+        tokens offered to the verifier, ``accepted`` of them kept. The
+        accept RATE is the knob operators tune k against — visible
+        cumulatively on /metrics and windowed via
+        :meth:`recent_accept_rate`."""
+        self.spec_proposed += int(proposed)
+        self.spec_accepted += int(accepted)
+        self._c_spec_proposed.inc(int(proposed))
+        self._c_spec_accepted.inc(int(accepted))
+        if proposed > 0:
+            self._spec_window.add(accepted / proposed)
+            # the ratio as a first-class gauge too, so a /metrics scrape
+            # reads the accept rate without rate() arithmetic
+            self._g_spec_accept.set(self.spec_accepted / self.spec_proposed)
+
+    def spec_accept_rate(self) -> Optional[float]:
+        """Cumulative draft accept rate (None before any speculation)."""
+        if self.spec_proposed == 0:
+            return None
+        return self.spec_accepted / self.spec_proposed
+
+    def recent_accept_rate(self) -> Optional[float]:
+        """Mean accept fraction over the last 64 speculative ticks — what
+        the sentinel's degenerate-draft check consumes (a draft can go
+        stale mid-run; the cumulative rate would hide it)."""
+        return self._spec_window.summary()["mean"]
 
     def record_token(self, request_id: int, first: bool) -> None:
         now = self.clock()
@@ -250,6 +302,9 @@ class ServingMetrics:
             "blocks_saved": self.blocks_saved,
             "shared_blocks": self.shared_blocks.summary(),
             "shared_blocks_peak": self.shared_blocks_peak,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+            "spec_accept_rate": self.spec_accept_rate(),
             "tokens_emitted": self.tokens_emitted,
             "tokens_per_second": self.tokens_per_second(),
             "ticks": self.ticks,

@@ -132,11 +132,16 @@ class _FleetMetrics:
 
     def summary(self) -> dict:
         per = [e.metrics.summary() for e in self._fleet.replicas]
+        proposed = sum(p["spec_proposed"] for p in per)
+        accepted = sum(p["spec_accepted"] for p in per)
         return {
             "replicas": len(per),
             "tokens_emitted": sum(p["tokens_emitted"] for p in per),
             "rejected": sum(p["rejected"] for p in per),
             "finished": _sum_dicts(p["finished"] for p in per),
+            "spec_proposed": proposed,
+            "spec_accepted": accepted,
+            "spec_accept_rate": (accepted / proposed) if proposed else None,
             "per_replica": per,
         }
 

@@ -53,7 +53,16 @@ class Scheduler:
     prefill batch (and therefore the prefill program's batch axis).
     ``prefill_interval``: admit only every N-th tick; between admission
     ticks the engine runs pure decode ticks, trading TTFT for smoother
-    per-token latency under load.
+    per-token latency under load (``Engine(overlap_prefill=True)``
+    attacks the same contention without rationing admission ticks).
+
+    Queue-wait accounting contract: the engine records a request's queue
+    wait at the admission POP (``ServingMetrics.record_admit``) — every
+    admitted request contributes its full submit→admit wait exactly once,
+    whatever interval phase or overlap mode the tick runs under — and a
+    deadline expiry records its terminal wait too
+    (``record_expired``), so the queue-wait SLO series cannot undercount
+    exactly when off-phase ticks leave requests waiting.
     """
 
     def __init__(

@@ -179,6 +179,18 @@ class ServingServer:
     ``/healthz``/``/readyz`` reflect loop + fault + drain state, ``/varz``
     is :meth:`stats`, ``/trace`` the live span ring. None (the default)
     binds nothing — the no-telemetry server is byte-for-byte the old one.
+    ``free_running``: with a :class:`~gradaccum_tpu.serving.replicated.
+    ReplicatedEngine`, run ONE loop thread per replica instead of ticking
+    the fleet in lockstep — a replica mid-prefill no longer stalls its
+    neighbors' token streams, which is the overlap ``ReplicatedEngine.
+    drain`` measures, delivered to streaming traffic. Each replica gets
+    its own engine lock (submits route to a replica under that replica's
+    lock), its own watchdog window, its own sentinel heartbeat, and its
+    own failure domain: a faulted replica recovers and requeues through
+    the same bounded contract while the others keep streaming. The
+    deterministic :class:`SimulationDriver` stays on lockstep ``step()``
+    by construction — free-running is a server-only mode. Ignored (plain
+    lockstep loop) for a single non-replicated engine.
     """
 
     def __init__(
@@ -193,6 +205,7 @@ class ServingServer:
         slo=None,
         telemetry_port: Optional[int] = None,
         telemetry_host: str = "127.0.0.1",
+        free_running: bool = False,
     ):
         self._engine = engine
         self._flight = flight
@@ -208,9 +221,12 @@ class ServingServer:
         self._telemetry_port = telemetry_port
         self._telemetry_host = telemetry_host
         self._telemetry = None
-        # a sentinel remediation's recover request, honored by the loop
-        # thread at its next iteration (guarded by _hlock)
-        self._nudge: Optional[str] = None
+        # sentinel remediations' recover requests, honored by loop threads
+        # at their next iteration (guarded by _hlock): one pending nudge
+        # per target replica (None = untargeted / the lockstep engine),
+        # so a nudge aimed at a wedged replica can never block later
+        # remediations for healthy ones
+        self._nudges: Dict[Optional[int], str] = {}
         # a fleet engine forwards per-replica heartbeats itself; the
         # server only feeds engine-level signals for single engines
         if sentinel is not None and hasattr(engine, "replicas") \
@@ -231,25 +247,60 @@ class ServingServer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
-        self._watchdog = (
-            None if watchdog_timeout is None
-            # pin only an explicitly injected engine tracer; None lets the
-            # watchdog resolve the global at fire time (same as the engine)
-            else Watchdog(watchdog_timeout, self._on_stall,
-                          tracer=engine._tracer)
-        )
+        self._watchdog_timeout = watchdog_timeout
+        # free-running needs independent failure domains: per-replica
+        # engine locks (the fleet's Engine-per-thread granularity), fault
+        # budgets, and watchdog windows — one shared watchdog cannot time
+        # N concurrent ticks
+        self._free_running = bool(free_running) and \
+            hasattr(engine, "replicas")
+        self._threads: List[threading.Thread] = []
+        if self._free_running:
+            n = len(engine.replicas)
+            self._rlocks = [threading.Lock() for _ in range(n)]
+            self._rfaults = [0] * n
+            self._watchdog = None
+            self._watchdogs = (
+                None if watchdog_timeout is None
+                else [Watchdog(watchdog_timeout, self._on_stall,
+                               tracer=engine._tracer) for _ in range(n)]
+            )
+        else:
+            self._rlocks = None
+            self._rfaults = None
+            self._watchdogs = None
+            self._watchdog = (
+                None if watchdog_timeout is None
+                # pin only an explicitly injected engine tracer; None lets
+                # the watchdog resolve the global at fire time (same as
+                # the engine)
+                else Watchdog(watchdog_timeout, self._on_stall,
+                              tracer=engine._tracer)
+            )
 
     def start(self) -> "ServingServer":
-        if self._thread is not None:
+        if self._thread is not None or self._threads:
             raise RuntimeError("server already started")
         if self._stop.is_set():
             raise RuntimeError("server was stopped and cannot be restarted; "
                                "build a new ServingServer around the engine")
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="serving-engine")
-        self._thread.start()
-        if self._watchdog is not None:
-            self._watchdog.start()
+        if self._free_running:
+            self._threads = [
+                threading.Thread(target=self._replica_loop, args=(i,),
+                                 daemon=True, name=f"serving-replica-{i}")
+                for i in range(len(self._engine.replicas))
+            ]
+            for th in self._threads:
+                th.start()
+            if self._watchdogs is not None:
+                for wd in self._watchdogs:
+                    wd.start()
+        else:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="serving-engine")
+            self._thread.start()
+            if self._watchdog is not None:
+                self._watchdog.start()
         if self._sentinel is not None \
                 and self._sentinel.check_interval is not None:
             # the background checker is the lease backstop for a loop
@@ -283,11 +334,19 @@ class ServingServer:
         should conclude from a failing liveness probe."""
         with self._hlock:
             error = self._error
-        alive = self._thread is not None and self._thread.is_alive()
+        if self._free_running:
+            alive = bool(self._threads) and \
+                all(t.is_alive() for t in self._threads)
+            tick = max(e.tick_count for e in self._engine.replicas)
+            faults = max(self._rfaults)
+        else:
+            alive = self._thread is not None and self._thread.is_alive()
+            tick = self._engine.tick_count
+            faults = self._faults
         detail = {
             "engine_thread": bool(alive),
-            "consecutive_faults": self._faults,
-            "tick": self._engine.tick_count,
+            "consecutive_faults": faults,
+            "tick": tick,
             "error": None if error is None else repr(error),
         }
         return (alive and error is None), detail
@@ -309,15 +368,21 @@ class ServingServer:
             ok = ok and not firing
         return (ok and not draining), detail
 
-    def request_recover(self, reason: str) -> None:
+    def request_recover(self, reason: str,
+                        replica: Optional[int] = None) -> None:
         """Ask the loop thread to run the engine-fault recovery path
         (recover → bounded requeue → flight dump) at its next iteration —
         the sentinel remediation entry point. Safe from any thread; a
-        no-op if the server already failed. The loop must be alive to
-        honor it: a loop wedged inside a tick is the watchdog's job."""
+        no-op if the server already failed. ``replica`` targets ONE
+        free-running replica's loop (an untargeted nudge is claimed by
+        the first loop to poll); the lockstep server recovers its whole
+        engine and ignores it. The loop must be alive to honor it either
+        way: a loop wedged inside a tick is the watchdog's job. Pending
+        nudges are PER TARGET — a nudge parked on an unresponsive replica
+        does not block remediations for the others."""
         with self._hlock:
-            if self._error is None and self._nudge is None:
-                self._nudge = reason
+            if self._error is None and replica not in self._nudges:
+                self._nudges[replica] = reason
 
     def stop(self) -> None:
         """Stop the loop and close the engine. Re-raises (wrapped) any
@@ -335,14 +400,21 @@ class ServingServer:
         if self._sentinel is not None:
             self._sentinel.stop()
         wedged = False
+        join_timeout = (None if self._watchdog_timeout is None
+                        else max(2 * self._watchdog_timeout, 1.0))
         if self._thread is not None:
-            join_timeout = (None if self._watchdog is None
-                            else max(2 * self._watchdog.timeout, 1.0))
             self._thread.join(join_timeout)
             wedged = self._thread.is_alive()
             self._thread = None
+        for th in self._threads:
+            th.join(join_timeout)
+            wedged = wedged or th.is_alive()
+        self._threads = []
         if self._watchdog is not None:
             self._watchdog.stop()
+        if self._watchdogs is not None:
+            for wd in self._watchdogs:
+                wd.stop()
         self._abort_handles("aborted")  # in-flight requests must not hang
         if wedged:
             # daemon thread stuck in a dispatch holding _lock: it dies with
@@ -417,7 +489,29 @@ class ServingServer:
         :class:`~gradaccum_tpu.serving.replicated.ReplicatedEngine` the
         snapshot is the fleet aggregate plus a full ``per_replica``
         breakdown (which replica is saturated is the first operator
-        question replicas introduce)."""
+        question replicas introduce). Under ``free_running`` each
+        replica's block is taken under ITS engine lock — internally
+        consistent per replica, while replicas may show different ticks
+        (they genuinely run at different ticks; the lockstep fleet's
+        single-tick stamp is the mode's own invariant, not this one's)."""
+        if self._free_running:
+            per = []
+            for i, e in enumerate(self._engine.replicas):
+                with self._rlocks[i]:
+                    per.append(self._engine_stats(e))
+            out = {
+                "replicas": len(per),
+                "tick": max(p["tick"] for p in per),
+                "free_running": True,
+                "queue_depth": sum(p["queue_depth"] for p in per),
+                "active_slots": sum(p["active_slots"] for p in per),
+                "num_slots": sum(p["num_slots"] for p in per),
+                "per_replica": per,
+            }
+            if self._engine.paged:
+                out["free_kv_blocks"] = sum(p["free_kv_blocks"] for p in per)
+                out["num_kv_blocks"] = sum(p["num_kv_blocks"] for p in per)
+            return out
         with self._lock:
             engine = self._engine
             replicas = getattr(engine, "replicas", None)
@@ -440,10 +534,13 @@ class ServingServer:
     def cancel(self, request_id: int) -> bool:
         """Thread-safe cancel of a queued or RUNNING request (the engine's
         ``cancel`` is not safe against the loop thread's concurrent tick —
-        this wrapper holds the engine lock). The request's handle finishes
-        with reason "cancelled", keeping any tokens already streamed.
-        False for unknown / already-finished ids."""
-        with self._lock:
+        this wrapper holds the engine lock; under ``free_running``, the
+        owning replica's lock). The request's handle finishes with reason
+        "cancelled", keeping any tokens already streamed. False for
+        unknown / already-finished ids."""
+        lock = (self._rlocks[request_id % len(self._engine.replicas)]
+                if self._free_running else self._lock)
+        with lock:
             ok = self._engine.cancel(request_id)
             if ok:
                 # the handle owns the (partial) output now
@@ -465,6 +562,9 @@ class ServingServer:
                 raise RuntimeError(
                     "serving engine thread died"
                 ) from self._error
+        if self._free_running:
+            _, handle = self._dispatch_free(prompt, max_new_tokens, **kwargs)
+            return handle
         # submission + registration stay atomic w.r.t. the engine thread:
         # _lock is held across both, so no tick can retire the request
         # before its handle exists. Lock order is always _lock -> _hlock.
@@ -481,6 +581,45 @@ class ServingServer:
                     ) from self._error
                 self._handles[rid] = handle
         return handle
+
+    def _dispatch_free(self, prompt, max_new_tokens: int,
+                       register: bool = True,
+                       handle: Optional[StreamHandle] = None,
+                       **kwargs) -> Tuple[int, Optional[StreamHandle]]:
+        """Free-running dispatch: the fleet's candidate order (prefix
+        affinity, then load) probed replica by replica, each under ITS
+        engine lock only — a submit never blocks on an unrelated replica's
+        tick. Registration (a fresh handle, or a requeued one) happens
+        before the owning replica's lock is released, so its loop cannot
+        retire the request first. Raises :class:`QueueFull` only when
+        every replica refuses (one client-visible rejection charged to
+        the best candidate, matching ``ReplicatedEngine.submit``).
+        Returns ``(rid, handle)``."""
+        fleet = self._engine
+        arr = np.asarray(prompt, np.int32).reshape(-1)
+        order = fleet._candidates(arr)
+        attempts = [(idx, True) for idx in order] + [(order[0], False)]
+        for idx, quiet in attempts:
+            with self._rlocks[idx]:
+                try:
+                    rid = fleet.replicas[idx].submit(
+                        prompt, max_new_tokens, _quiet_full=quiet, **kwargs)
+                except QueueFull:
+                    if quiet:
+                        continue
+                    raise
+                h = handle
+                if register:
+                    h = handle if handle is not None else StreamHandle(rid)
+                    h.request_id = rid
+                    with self._hlock:
+                        if self._error is not None:
+                            raise RuntimeError(
+                                "serving engine thread died"
+                            ) from self._error
+                        self._handles[rid] = h
+                return rid, h
+        raise AssertionError("unreachable: final attempt raises or returns")
 
     def _abort_handles(self, reason: str) -> None:
         with self._hlock:
@@ -505,7 +644,7 @@ class ServingServer:
         # stalled engine thread may hold it forever)
         self._fail_handles(TimeoutError(
             f"engine tick stalled for {elapsed:.2f}s "
-            f"(watchdog timeout {self._watchdog.timeout}s)"
+            f"(watchdog timeout {self._watchdog_timeout}s)"
         ))
         if self._flight is not None:
             # the ring holds the ticks leading into the stall — exactly the
@@ -518,38 +657,54 @@ class ServingServer:
             except Exception:  # noqa: BLE001
                 pass
 
-    def _handle_engine_fault(self, exc: BaseException) -> None:
+    def _handle_engine_fault(self, exc: BaseException,
+                             replica: Optional[int] = None) -> None:
         """Recover the engine, requeue in-flight requests (bounded), fail
         the rest. Gives up — fails everything, poisons the server — after
-        ``max_engine_faults`` consecutive faulted ticks."""
-        self._faults += 1
-        give_up = self._faults > self._max_engine_faults
-        tr = self._engine.tracer
+        ``max_engine_faults`` consecutive faulted ticks. ``replica``
+        scopes the whole path to ONE free-running replica: only its
+        engine recovers (under its lock), only its requests reconcile,
+        and its requeues re-dispatch across the healthy fleet."""
+        eng = (self._engine if replica is None
+               else self._engine.replicas[replica])
+        elock = self._lock if replica is None else self._rlocks[replica]
+        if replica is None:
+            self._faults += 1
+            consecutive = self._faults
+        else:
+            self._rfaults[replica] += 1
+            consecutive = self._rfaults[replica]
+        give_up = consecutive > self._max_engine_faults
+        tr = eng.tracer
         if tr.enabled:
             tr.event("serve/engine_fault", cat="resilience",
                      error=type(exc).__name__,
-                     consecutive=self._faults, give_up=give_up)
+                     consecutive=consecutive, give_up=give_up,
+                     **({} if replica is None else {"replica": replica}))
         if self._sentinel is not None \
                 and not isinstance(exc, SentinelRemediation):
             # real faults land in the anomaly log; a sentinel-requested
             # recover does not re-note itself (it IS the remediation)
-            self._sentinel.note_fault(error=type(exc).__name__)
+            self._sentinel.note_fault(error=type(exc).__name__,
+                                      replica=replica)
         with self._hlock:
-            known = list(self._handles)
+            known = [rid for rid in self._handles
+                     if replica is None
+                     or rid % len(self._engine.replicas) == replica]
         retired = []
-        with self._lock:
-            failed = self._engine.recover()
+        with elock:
+            failed = eng.recover()
             for req in failed:  # server handles own the output now
-                self._engine.results.pop(req.request_id, None)
-                self._engine.status.pop(req.request_id, None)
+                eng.results.pop(req.request_id, None)
+                eng.status.pop(req.request_id, None)
             # requests the faulted tick retired BEFORE raising (deadline
             # expiry, finish-at-admission) lost their StepEvents with the
             # exception — reconcile them from engine status so their
             # handles finish instead of hanging
             for rid in known:
-                if self._engine.status.get(rid) in ("done", "timeout",
-                                                    "cancelled"):
-                    tokens, status = self._engine.pop_result(rid)
+                if eng.status.get(rid) in ("done", "timeout",
+                                           "cancelled"):
+                    tokens, status = eng.pop_result(rid)
                     retired.append((rid, tokens, status))
         for rid, tokens, status in retired:
             with self._hlock:
@@ -587,12 +742,25 @@ class ServingServer:
         for req, n, handle in plans:
             handle._restart()  # the generation re-runs from scratch
             remaining = (None if req.deadline_tick is None
-                         else max(0, req.deadline_tick - self._engine.tick_count))
+                         else max(0, req.deadline_tick - eng.tick_count))
             try:
-                with self._lock:
-                    rid = self._engine.submit(
-                        req.prompt, req.max_new_tokens, eos_id=req.eos_id,
-                        rng_seed=req.rng_seed, deadline_ticks=remaining,
+                if replica is None:
+                    with self._lock:
+                        rid = self._engine.submit(
+                            req.prompt, req.max_new_tokens,
+                            eos_id=req.eos_id, rng_seed=req.rng_seed,
+                            deadline_ticks=remaining,
+                        )
+                else:
+                    # free-running requeue re-dispatches across the whole
+                    # fleet (the faulted replica may be the worst home for
+                    # it now); registration happens inside, under the new
+                    # owner's lock, so its loop can't retire the request
+                    # before the handle is rebound
+                    rid, _ = self._dispatch_free(
+                        req.prompt, req.max_new_tokens, handle=handle,
+                        eos_id=req.eos_id, rng_seed=req.rng_seed,
+                        deadline_ticks=remaining,
                     )
             except Exception as resubmit_exc:  # e.g. QueueFull on a hot queue
                 handle._fail(resubmit_exc)
@@ -602,7 +770,14 @@ class ServingServer:
                     dead.append(handle)
                     continue
                 handle.request_id = rid
-                self._handles[rid] = handle
+                if replica is None:
+                    self._handles[rid] = handle
+                elif rid not in self._handles:
+                    # _dispatch_free registered the handle under the new
+                    # owner's lock, and that owner's loop has ALREADY
+                    # finished the request — re-registering would leak a
+                    # completed handle
+                    continue
                 self._requeues[rid] = n + 1
             if tr.enabled:
                 tr.event("req/requeue", cat="resilience", rid=rid,
@@ -619,7 +794,7 @@ class ServingServer:
                 self._flight.dump("engine-fault-giveup" if give_up
                                   else "engine-fault",
                                   extra={"error": repr(exc),
-                                         **self._engine.obs_tags()})
+                                         **eng.obs_tags()})
             except Exception:  # noqa: BLE001
                 pass
 
@@ -630,11 +805,14 @@ class ServingServer:
                 with self._hlock:
                     if self._error is not None:
                         return  # stall/give-up already failed the handles
-                    nudge, self._nudge = self._nudge, None
+                    nudge = (self._nudges.pop(next(iter(self._nudges)))
+                             if self._nudges else None)
                 if nudge is not None:
                     # a sentinel remediation: run the PROVEN fault path —
                     # recover, bounded requeue, flight dump — on the loop
-                    # thread, where the engine lock is safe to take
+                    # thread, where the engine lock is safe to take (the
+                    # lockstep engine recovers whole; a replica target is
+                    # a free-running concept, so any pending nudge counts)
                     self._handle_engine_fault(SentinelRemediation(nudge))
                     continue
                 try:
@@ -669,6 +847,18 @@ class ServingServer:
                     if not hasattr(self._engine, "replicas"):
                         snt.heartbeat(tick=self._engine.tick_count,
                                       busy=not self._engine.idle)
+                        if getattr(self._engine, "speculate_k", 0):
+                            snt.observe_accept(
+                                self._engine.metrics.recent_accept_rate(),
+                                replica=self._engine.replica_id)
+                    else:
+                        # per-replica accept rates: one replica's stale
+                        # draft must not hide behind the fleet average
+                        for e in self._engine.replicas:
+                            if getattr(e, "speculate_k", 0):
+                                snt.observe_accept(
+                                    e.metrics.recent_accept_rate(),
+                                    replica=e.replica_id)
                     snt.observe_tick(time.monotonic() - t0)
                     snt.check()
                 if self._slo is not None:
@@ -686,6 +876,95 @@ class ServingServer:
                     with self._lock:
                         self._engine.pop_result(rid)  # handle holds the tokens
         except BaseException as e:  # a dead dispatch loop must not strand callers
+            self._fail_handles(e)
+            raise
+
+    def _replica_loop(self, i: int) -> None:
+        """One free-running replica's serving loop: tick MY engine under
+        MY lock at my own pace — no fleet barrier, so this replica's
+        streams advance while a neighbor prefills or recovers. Mirrors
+        ``_loop`` with the fleet pieces scoped down: per-replica watchdog
+        window, per-replica sentinel heartbeat/latency/accept feeds,
+        per-replica fault budget (a give-up still poisons the whole
+        server — the budgets bound faults, not the blast radius of giving
+        up). The SLO evaluator ticks from replica 0's loop (it reads the
+        one shared fleet registry; N tickers would just multiply pulls)."""
+        eng = self._engine.replicas[i]
+        lock = self._rlocks[i]
+        wd = self._watchdogs[i] if self._watchdogs is not None else None
+        snt = self._sentinel
+        try:
+            while not self._stop.is_set():
+                with self._hlock:
+                    if self._error is not None:
+                        return  # stall/give-up already failed the handles
+                    # claim only a nudge FOR this replica (or an
+                    # untargeted one) — a dead_replica(replica=2)
+                    # remediation must recover replica 2, not whichever
+                    # healthy loop polls first. A wedged target can't
+                    # claim (its nudge just sits on its own key); that is
+                    # the watchdog's job.
+                    nudge = self._nudges.pop(i, None)
+                    if nudge is None and None in self._nudges:
+                        nudge = self._nudges.pop(None)
+                if nudge is not None:
+                    self._handle_engine_fault(SentinelRemediation(nudge),
+                                              replica=i)
+                    continue
+                try:
+                    with lock:
+                        if eng.idle:
+                            events = None
+                        else:
+                            if wd is not None:
+                                wd.arm()
+                            t0 = time.monotonic() if snt is not None else 0.0
+                            try:
+                                events = eng.step()
+                            finally:
+                                if wd is not None:
+                                    wd.disarm()
+                except Exception as e:
+                    self._handle_engine_fault(e, replica=i)
+                    continue
+                if events is None:
+                    if snt is not None:
+                        snt.heartbeat(replica=i, tick=eng.tick_count,
+                                      busy=False)
+                        snt.check()
+                    if self._slo is not None and i == 0:
+                        # MY replica being idle says nothing about the
+                        # fleet: the evaluator pulls the SHARED registry,
+                        # so its windows must advance (fire AND resolve)
+                        # while neighbors serve traffic
+                        self._slo.tick()
+                    self._stop.wait(self._idle_sleep)
+                    continue
+                self._rfaults[i] = 0  # a clean tick resets this budget
+                if snt is not None:
+                    snt.heartbeat(replica=i, tick=eng.tick_count,
+                                  busy=not eng.idle)
+                    snt.observe_tick(time.monotonic() - t0, replica=i)
+                    if getattr(eng, "speculate_k", 0):
+                        snt.observe_accept(eng.metrics.recent_accept_rate(),
+                                           replica=i)
+                    snt.check()
+                if self._slo is not None and i == 0:
+                    self._slo.tick()
+                for rid, tok in events.emitted:
+                    with self._hlock:
+                        handle = self._handles.get(rid)
+                    if handle is not None:
+                        handle._put(tok)
+                for rid, reason in events.finished:
+                    with self._hlock:
+                        handle = self._handles.pop(rid, None)
+                        self._requeues.pop(rid, None)
+                    if handle is not None:
+                        handle._finish(reason)
+                    with lock:
+                        eng.pop_result(rid)  # handle holds the tokens
+        except BaseException as e:  # a dead replica loop must not strand callers
             self._fail_handles(e)
             raise
 

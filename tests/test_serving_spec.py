@@ -1,0 +1,666 @@
+"""Speculative decoding: parity, accept-length edges, overlap, bf16 KV.
+
+The load-bearing gate is GREEDY SPEC PARITY: with speculation on — any
+draft, any accept rate — every request's greedy output must be
+token-for-token what ``generate_cached`` produces for that prompt alone,
+on the fixed AND paged pools (and through a TP-sharded mesh engine). The
+draft only ever changes how many target dispatches a token costs, never
+which token comes out: the verify program computes the same logits a scan
+of single steps would, and the accept rule emits the target's own argmax
+at every column it keeps.
+
+Accept-length edge cases ride along: k=0 fallback, an all-rejected cycle
+(garbage draft), accepts crossing a page boundary, accepts reading a
+refcounted shared-prefix tail, cancel mid-speculation, and recover() with
+a dirty draft cache. Plus the satellites: the bf16 ``cache_dtype`` knob,
+the queue-wait accounting fix under ``prefill_interval``, the
+free-running per-replica server loops, and the sentinel's
+degenerate-draft anomaly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gradaccum_tpu.resilience import faults
+from gradaccum_tpu.resilience.faults import (
+    FaultInjector,
+    FaultSchedule,
+    FaultSpec,
+)
+
+pytestmark = pytest.mark.spec
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(
+        jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)}
+    )
+    return cfg, bundle, params
+
+
+@pytest.fixture(scope="module")
+def draft(tiny_lm):
+    """A 1-layer draft truncated from the target: partial agreement, so
+    accept lengths actually vary across cycles."""
+    from gradaccum_tpu.models.gpt_decode import truncate_draft_params
+
+    cfg, _, params = tiny_lm
+    return truncate_draft_params(params, cfg, 1)
+
+
+def _run_parity(engine, params, cfg, seed=0, n=8, **trace_kw):
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import SimulationDriver
+
+    driver = SimulationDriver(engine, seed=seed)
+    kw = dict(arrival_rate=0.6, prompt_len=(1, 12), max_new=(1, 12))
+    kw.update(trace_kw)
+    trace = driver.make_trace(n, **kw)
+    records = driver.run(trace)
+    for item, rec in zip(trace, records):
+        assert rec["status"] == "done"
+        want = generate_cached(params, cfg, item.prompt, item.max_new_tokens)
+        np.testing.assert_array_equal(
+            np.asarray(rec["tokens"]),
+            np.asarray(want)[0, item.prompt.size:],
+        )
+    return engine
+
+
+# -- the spec parity gates ----------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_spec_greedy_parity_fixed_pool(tiny_lm, draft, seed):
+    """Fixed pool + truncated draft: token-for-token greedy parity under
+    seeded traces, and the draft+verify cycle compiled exactly once."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = draft
+    engine = _run_parity(
+        Engine(params, cfg, num_slots=4, max_len=32, speculate_k=3,
+               draft_params=dparams, draft_cfg=dcfg),
+        params, cfg, seed=seed,
+    )
+    assert engine.decode_compile_count() == 1
+    assert engine.metrics.spec_proposed > 0
+    assert engine.idle
+
+
+def test_spec_greedy_parity_paged_pool(tiny_lm, draft):
+    """Paged pool, page_size 4, k=5 > page_size: accepted runs routinely
+    CROSS page boundaries (the verify scatter translates every position
+    through the page table independently)."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = draft
+    engine = _run_parity(
+        Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+               speculate_k=5, draft_params=dparams, draft_cfg=dcfg),
+        params, cfg, seed=1, prompt_len=(3, 12), max_new=(6, 12),
+    )
+    assert engine.decode_compile_count() == 1
+    # clean pool teardown after ragged accept lengths
+    assert engine.pool.free_blocks == engine.pool.num_blocks
+
+
+def test_spec_all_accept_crosses_page_boundary(tiny_lm):
+    """Draft == target (full-depth 'truncation'): accept rate ~1 (not
+    exactly — the draft's 1-wide and the verifier's (k+1)-wide programs
+    can split a near-tied argmax; parity is unaffected because emission
+    always uses the VERIFIER's argmax), so cycles routinely advance k+1
+    positions and stride page boundaries with page_size 2."""
+    from gradaccum_tpu.models.gpt_decode import truncate_draft_params
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = truncate_draft_params(params, cfg, cfg.num_layers)
+    engine = _run_parity(
+        Engine(params, cfg, num_slots=2, max_len=32, page_size=2,
+               speculate_k=4, draft_params=dparams, draft_cfg=dcfg),
+        params, cfg, seed=2, n=5, max_new=(8, 12),
+    )
+    assert engine.metrics.spec_accept_rate() >= 0.9
+
+
+def test_spec_all_rejected_still_emits_target_tokens(tiny_lm):
+    """A garbage draft (different random weights) rejects ~every proposal:
+    each cycle still emits >= 1 correct token (the target's own argmax at
+    the first mismatch), so parity holds at accept rate ~0."""
+    from gradaccum_tpu.models.gpt import gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import truncate_draft_params
+    from gradaccum_tpu.serving import Engine
+
+    cfg, bundle, params = tiny_lm
+    garbage = bundle.init(
+        jax.random.PRNGKey(99), {"input_ids": np.zeros((1, 8), np.int32)}
+    )
+    dparams, dcfg = truncate_draft_params(garbage, cfg, 2)
+    engine = _run_parity(
+        Engine(params, cfg, num_slots=3, max_len=32, speculate_k=2,
+               draft_params=dparams, draft_cfg=dcfg),
+        params, cfg, seed=3, n=6,
+    )
+    rate = engine.metrics.spec_accept_rate()
+    assert rate is not None and rate < 0.5
+
+
+def test_spec_k0_fallback_is_plain_engine(tiny_lm, draft):
+    """speculate_k=0 is the plain path bit-for-bit: same programs, same
+    tokens, no draft state (even with draft params supplied)."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = draft
+    engine = Engine(params, cfg, num_slots=2, max_len=32, speculate_k=0,
+                    draft_params=dparams, draft_cfg=dcfg)
+    assert engine._spec_tick_fn is None
+    assert engine._draft_k is None
+    _run_parity(engine, params, cfg, seed=4, n=5)
+    assert engine.metrics.spec_proposed == 0
+
+
+def test_spec_validation(tiny_lm, draft):
+    import dataclasses
+
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = draft
+    with pytest.raises(ValueError, match="draft_params"):
+        Engine(params, cfg, speculate_k=2)
+    with pytest.raises(ValueError, match="decode_block"):
+        Engine(params, cfg, speculate_k=2, draft_params=dparams,
+               draft_cfg=dcfg, decode_block=4)
+    with pytest.raises(ValueError, match="vocab"):
+        Engine(params, cfg, speculate_k=2, draft_params=dparams,
+               draft_cfg=dataclasses.replace(dcfg, vocab_size=7))
+    with pytest.raises(ValueError, match="num_layers"):
+        from gradaccum_tpu.models.gpt_decode import truncate_draft_params
+
+        truncate_draft_params(params, cfg, cfg.num_layers + 1)
+
+
+# -- prefix sharing + speculation ---------------------------------------------
+
+
+def test_spec_accept_into_shared_prefix_tail(tiny_lm):
+    """Shared-system-prompt traffic with speculation: concurrent sharers
+    adopt the same refcounted blocks, verify READS the shared tail while
+    its writes stay structurally private (positions start past the shared
+    region), outputs match solo generation, and every block refcount
+    unwinds to a full free list."""
+    from gradaccum_tpu.models.gpt_decode import (
+        generate_cached,
+        truncate_draft_params,
+    )
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    # full-depth draft: accept rate 1, so accepted runs reliably extend
+    # FROM the shared region's tail on the very first cycles
+    dparams, dcfg = truncate_draft_params(params, cfg, cfg.num_layers)
+    engine = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                    prefix_cache=True, speculate_k=3,
+                    draft_params=dparams, draft_cfg=dcfg)
+    rng = np.random.default_rng(7)
+    system = rng.integers(0, cfg.vocab_size, 9).astype(np.int32)
+    rids = []
+    # max_new 12 spans several spec cycles, so sharers' lifetimes overlap
+    # across ticks and the shared-blocks gauge catches refcounts > 1
+    for i in range(6):
+        tail = rng.integers(0, cfg.vocab_size, 3).astype(np.int32)
+        p = np.concatenate([system, tail])
+        rids.append((engine.submit(p, 12), p))
+        engine.step()  # overlapping lifetimes -> live sharing
+    engine.run_until_idle()
+    for rid, p in rids:
+        want = np.asarray(generate_cached(params, cfg, p, 12))[0, p.size:]
+        np.testing.assert_array_equal(np.asarray(engine.results[rid]), want)
+    assert engine.metrics.prefix_hits > 0
+    assert engine.metrics.shared_blocks_peak > 0
+    assert engine.metrics.spec_accept_rate() >= 0.8
+    assert engine.pool.free_blocks == engine.pool.num_blocks
+
+
+# -- multi-chip leg -----------------------------------------------------------
+
+
+@pytest.mark.multichip
+def test_spec_parity_tp_mesh(tiny_lm, draft, serving_mesh_2):
+    """The TP leg: draft + verify programs GSPMD-sharded over a 2-chip
+    serving mesh (draft params via the same tp rules, draft cache on its
+    head axis) — greedy tokens identical to solo single-chip decoding."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = draft
+    engine = _run_parity(
+        Engine(params, cfg, num_slots=3, max_len=32, page_size=4,
+               num_blocks=24, mesh=serving_mesh_2, speculate_k=3,
+               draft_params=dparams, draft_cfg=dcfg),
+        params, cfg, seed=5, n=6,
+    )
+    assert engine.decode_compile_count() == 1
+
+
+# -- cancel / recover edges ---------------------------------------------------
+
+
+def test_spec_cancel_mid_speculation(tiny_lm, draft):
+    """Cancel a RUNNING speculative request between cycles: partial result
+    kept, blocks reclaimed, the other request unaffected."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = draft
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                    speculate_k=3, draft_params=dparams, draft_cfg=dcfg)
+    rng = np.random.default_rng(11)
+    p1 = rng.integers(0, cfg.vocab_size, 5).astype(np.int32)
+    p2 = rng.integers(0, cfg.vocab_size, 4).astype(np.int32)
+    r1 = engine.submit(p1, 10)
+    r2 = engine.submit(p2, 6)
+    engine.step()  # admit both
+    engine.step()  # at least one speculative cycle
+    assert engine.cancel(r1)
+    assert engine.status[r1] == "cancelled"
+    partial = list(engine.results[r1])
+    engine.run_until_idle()
+    want1 = np.asarray(generate_cached(params, cfg, p1, 10))[0, p1.size:]
+    np.testing.assert_array_equal(partial, want1[:len(partial)])
+    want2 = np.asarray(generate_cached(params, cfg, p2, 6))[0, p2.size:]
+    np.testing.assert_array_equal(np.asarray(engine.results[r2]), want2)
+    assert engine.pool.free_blocks == engine.pool.num_blocks
+
+
+@pytest.mark.faults
+def test_spec_recover_dirty_draft_cache_and_requeue_parity(tiny_lm, draft):
+    """A seeded crash mid-spec-tick leaves a dirty (possibly consumed)
+    draft cache; recover() rebuilds it with the pool, the server requeues,
+    and replayed greedy outputs still match solo generation."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = draft
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                    speculate_k=3, draft_params=dparams, draft_cfg=dcfg)
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in (5, 3, 6, 4)]
+    inj = FaultInjector(FaultSchedule(
+        [FaultSpec(faults.MID_DECODE_TICK, at=2)]
+    ))
+    with faults.installed(inj):
+        server = ServingServer(engine, max_requeues=2).start()
+        handles = [server.submit(p, 6) for p in prompts]
+        results = [h.result(timeout=120) for h in handles]
+        server.stop()
+    assert inj.fired == [(faults.MID_DECODE_TICK, 2, faults.KIND_CRASH)]
+    for prompt, (tokens, reason) in zip(prompts, results):
+        assert reason in ("eos", "length")
+        want = np.asarray(generate_cached(params, cfg, prompt, 6))
+        np.testing.assert_array_equal(np.asarray(tokens),
+                                      want[0, prompt.size:])
+    assert engine.idle
+    assert engine.pool.free_blocks == engine.pool.num_blocks
+
+
+def test_spec_eos_discards_accepted_tail(tiny_lm):
+    """eos hit inside an accepted run: emission stops exactly there, the
+    already-accepted tokens past it are discarded, the slot frees."""
+    from gradaccum_tpu.models.gpt_decode import (
+        generate_cached,
+        truncate_draft_params,
+    )
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = truncate_draft_params(params, cfg, cfg.num_layers)
+    rng = np.random.default_rng(17)
+    for attempt in range(8):
+        prompt = rng.integers(0, cfg.vocab_size, 6).astype(np.int32)
+        full = np.asarray(generate_cached(params, cfg, prompt, 8))[0, 6:]
+        k = next((i for i in range(1, len(full))
+                  if full[i] not in full[:i]), None)
+        if k is not None:
+            break
+    assert k is not None, "no usable eos token in 8 seeded prompts"
+    eos = int(full[k])
+    engine = Engine(params, cfg, num_slots=1, max_len=32, speculate_k=4,
+                    draft_params=dparams, draft_cfg=dcfg)
+    rid = engine.submit(prompt, 8, eos_id=eos)
+    engine.run_until_idle()
+    assert engine.results[rid] == list(full[:k + 1])
+    assert engine.status[rid] == "done"
+
+
+# -- sampled mode -------------------------------------------------------------
+
+
+def test_spec_sampled_deterministic_and_complete(tiny_lm, draft):
+    """Rejection sampling: seeded runs are reproducible, every request
+    completes with exactly its budget (no eos), and in-vocab tokens."""
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = draft
+
+    def run():
+        engine = Engine(params, cfg, num_slots=3, max_len=32,
+                        temperature=0.8, top_k=5, speculate_k=3,
+                        draft_params=dparams, draft_cfg=dcfg)
+        driver = SimulationDriver(engine, seed=21)
+        trace = driver.make_trace(6, arrival_rate=0.8, prompt_len=(2, 10),
+                                  max_new=(3, 10))
+        return trace, driver.run(trace)
+
+    trace, recs = run()
+    _, recs2 = run()
+    assert [r["tokens"] for r in recs] == [r["tokens"] for r in recs2]
+    for item, rec in zip(trace, recs):
+        assert rec["status"] == "done"
+        assert len(rec["tokens"]) == item.max_new_tokens
+        assert all(0 <= t < cfg.vocab_size for t in rec["tokens"])
+
+
+# -- metrics / manifest / obs -------------------------------------------------
+
+
+def test_spec_accept_rate_in_metrics_and_manifest(tiny_lm, draft):
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = draft
+    engine = Engine(params, cfg, num_slots=2, max_len=32, speculate_k=3,
+                    draft_params=dparams, draft_cfg=dcfg,
+                    overlap_prefill=True)
+    engine.submit(np.ones(4, np.int32), 6)
+    engine.run_until_idle()
+    m = engine.metrics.summary()
+    assert m["spec_proposed"] > 0
+    assert m["spec_accept_rate"] is not None
+    prom = engine.metrics.to_prometheus().replace("/", "_")
+    assert "serving_spec_proposed_total" in prom
+    assert "serving_spec_accepted_total" in prom
+    assert "serving_spec_accept_rate" in prom
+    man = engine.manifest()
+    assert man["speculate_k"] == 3
+    assert man["draft_num_layers"] == 1
+    assert man["overlap_prefill"] is True
+
+
+def test_sentinel_degenerate_draft_fires_and_resolves():
+    from gradaccum_tpu.obs.sentinel import DEGENERATE_DRAFT, Sentinel
+
+    s = Sentinel(clock=lambda: 0.0, accept_floor=0.2, accept_warmup=2,
+                 accept_consecutive=3)
+    fired = []
+    s.on(DEGENERATE_DRAFT, fired.append)
+    s.observe_accept(None)  # no speculation this tick: ignored
+    for _ in range(10):
+        s.observe_accept(0.05, replica=1)
+    assert len(fired) == 1 and fired[0].replica == 1  # level-held
+    assert (DEGENERATE_DRAFT, 1) in s.firing()
+    s.observe_accept(0.9, replica=1)
+    assert (DEGENERATE_DRAFT, 1) not in s.firing()
+
+
+# -- overlapped prefill -------------------------------------------------------
+
+
+def test_overlap_prefill_parity_fixed_and_paged(tiny_lm, draft):
+    """Dispatch-reordered admission changes intra-tick event order only:
+    per-request token streams are identical in both modes."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = draft
+    _run_parity(Engine(params, cfg, num_slots=4, max_len=32,
+                       overlap_prefill=True), params, cfg, seed=6)
+    _run_parity(Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                       speculate_k=3, draft_params=dparams, draft_cfg=dcfg,
+                       overlap_prefill=True), params, cfg, seed=7)
+
+
+def test_overlap_prefill_fault_recovers_admitted_requests(tiny_lm):
+    """The overlapped crash point sits after BOTH dispatches: freshly
+    admitted requests are in slots and recover() must hand them back."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32,
+                    overlap_prefill=True)
+    inj = FaultInjector(FaultSchedule(
+        [FaultSpec(faults.MID_DECODE_TICK, at=0)]
+    ))
+    rid = engine.submit(np.ones(4, np.int32), 4)
+    with faults.installed(inj):
+        with pytest.raises(faults.InjectedCrash):
+            engine.step()
+    failed = engine.recover()
+    assert [r.request_id for r in failed] == [rid]
+    assert engine.status[rid] == "error"
+    assert engine.pool.active_count == 0
+
+
+# -- bf16 KV cache ------------------------------------------------------------
+
+
+def test_cache_dtype_bf16_pools_and_draft(tiny_lm, draft):
+    """cache_dtype=bfloat16: both pool kinds and the draft cache store
+    bf16 (half the bytes/token the gauges charge), decode still computes
+    f32 logits, and generation runs to completion."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = draft
+    fixed = Engine(params, cfg, num_slots=2, max_len=32,
+                   cache_dtype=jnp.bfloat16)
+    assert fixed.pool.k.dtype == jnp.bfloat16
+    f32 = Engine(params, cfg, num_slots=2, max_len=32)
+    assert fixed._token_bytes * 2 == f32._token_bytes
+
+    paged = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                   speculate_k=2, draft_params=dparams, draft_cfg=dcfg,
+                   cache_dtype=jnp.bfloat16)
+    assert paged.pool.k.dtype == jnp.bfloat16
+    assert paged._draft_k.dtype == jnp.bfloat16
+    rid = paged.submit(np.ones(5, np.int32), 6)
+    paged.run_until_idle()
+    assert len(paged.results[rid]) == 6
+    assert paged.manifest()["cache_dtype"] == "bfloat16"
+
+
+def test_cache_dtype_default_unchanged(tiny_lm):
+    from gradaccum_tpu.models.gpt_decode import init_cache, init_paged_pool
+
+    cfg, _, _ = tiny_lm
+    assert init_cache(cfg, 2, 8).k.dtype == cfg.dtype
+    assert init_paged_pool(cfg, 4, 4)[0].dtype == cfg.dtype
+
+
+# -- queue-wait accounting (scheduler satellite) ------------------------------
+
+
+def test_queue_wait_recorded_once_under_prefill_interval(tiny_lm):
+    """prefill_interval=3: a request waiting out the off-phase ticks gets
+    ONE queue-wait sample carrying the FULL wait (submit -> admission),
+    on the tick clock."""
+    from gradaccum_tpu.serving import Engine, Scheduler
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=16,
+                    scheduler=Scheduler(prefill_interval=3))
+    engine.metrics.clock = lambda: float(engine.tick_count)
+    engine.step()  # tick 0, empty: now at tick 1 (off-phase)
+    rid = engine.submit(np.ones(3, np.int32), 8)
+    engine.step()  # tick 1: no admission (1 % 3 != 0)
+    assert engine.metrics.queue_wait.summary()["count"] == 0
+    engine.step()  # tick 2: no admission
+    engine.step()  # tick 3: admitted
+    assert engine.status[rid] == "running"
+    qw = engine.metrics.queue_wait.summary()
+    assert qw["count"] == 1
+    assert qw["mean"] == pytest.approx(2.0)  # submitted at tick 1, admitted 3
+    engine.run_until_idle()
+
+
+def test_queue_wait_counts_timeout_expiry(tiny_lm):
+    """A request expiring in queue contributes its (terminal) wait to the
+    queue-wait series instead of silently vanishing from the SLO view."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=1, max_len=16)
+    engine.metrics.clock = lambda: float(engine.tick_count)
+    engine.submit(np.ones(3, np.int32), 8)       # occupies the only slot
+    rid = engine.submit(np.ones(3, np.int32), 2, deadline_ticks=2)
+    engine.run_until_idle()
+    assert engine.status[rid] == "timeout"
+    qw = engine.metrics.queue_wait.summary()
+    assert qw["count"] == 2  # the admitted one AND the expired one
+    assert qw["p99"] >= 2.0  # the expired request's full (terminal) wait
+
+
+# -- free-running per-replica server loops ------------------------------------
+
+
+def test_free_running_server_parity_and_stats(tiny_lm):
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import ReplicatedEngine, ServingServer
+
+    cfg, _, params = tiny_lm
+    fleet = ReplicatedEngine(params, cfg, replicas=2, tp=None,
+                             num_slots=2, max_len=24)
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in (5, 3, 7, 4, 6, 2)]
+    srv = ServingServer(fleet, free_running=True).start()
+    try:
+        handles = [srv.submit(p, 6) for p in prompts]
+        for p, h in zip(prompts, handles):
+            toks, reason = h.result(timeout=120)
+            assert reason == "length"
+            want = np.asarray(generate_cached(params, cfg, p, 6))
+            np.testing.assert_array_equal(np.asarray(toks),
+                                          want[0, p.size:])
+        st = srv.stats()
+        assert st["free_running"] is True
+        assert st["replicas"] == 2
+        assert len(st["per_replica"]) == 2
+        # both replicas actually served (least-loaded dispatch spreads 6
+        # requests over 2x2 slots)
+        ticked = [p["tick"] for p in st["per_replica"]]
+        assert all(t > 0 for t in ticked)
+    finally:
+        srv.stop()
+
+
+def test_free_running_single_engine_falls_back_to_lockstep(tiny_lm):
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    srv = ServingServer(Engine(params, cfg, num_slots=1, max_len=16),
+                        free_running=True)
+    assert srv._free_running is False
+    srv.start()
+    toks, reason = srv.submit(np.ones(3, np.int32), 3).result(timeout=60)
+    assert reason == "length" and len(toks) == 3
+    srv.stop()
+
+
+@pytest.mark.faults
+def test_free_running_replica_fault_recovers_alone(tiny_lm):
+    """A fault on one free-running replica recovers and requeues through
+    the bounded contract while the fleet keeps serving; outputs stay
+    token-identical."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import ReplicatedEngine, ServingServer
+
+    cfg, _, params = tiny_lm
+    fleet = ReplicatedEngine(params, cfg, replicas=2, tp=None,
+                             num_slots=2, max_len=24)
+    rng = np.random.default_rng(29)
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in (5, 4, 6, 3)]
+    inj = FaultInjector(FaultSchedule(
+        [FaultSpec(faults.MID_DECODE_TICK, at=1)]
+    ))
+    with faults.installed(inj):
+        srv = ServingServer(fleet, free_running=True, max_requeues=2).start()
+        handles = [srv.submit(p, 5) for p in prompts]
+        results = [h.result(timeout=120) for h in handles]
+        srv.stop()  # recovered fault: must NOT raise
+    assert inj.fired  # the schedule actually hit a replica tick
+    for p, (toks, reason) in zip(prompts, results):
+        assert reason in ("eos", "length")
+        want = np.asarray(generate_cached(params, cfg, p, 5))
+        np.testing.assert_array_equal(np.asarray(toks), want[0, p.size:])
+
+
+def test_free_running_targeted_recover_nudge(tiny_lm):
+    """A sentinel recover nudge targeted at replica 1 must be honored by
+    replica 1's loop (its in-flight work requeues and completes), never
+    claimed by replica 0 — the dead_replica remediation's routing."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import ReplicatedEngine, ServingServer
+
+    cfg, _, params = tiny_lm
+    fleet = ReplicatedEngine(params, cfg, replicas=2, tp=None,
+                             num_slots=2, max_len=24)
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in (5, 4, 6)]
+    srv = ServingServer(fleet, free_running=True, max_requeues=2).start()
+    try:
+        handles = [srv.submit(p, 12) for p in prompts]
+        srv.request_recover("test:dead_replica replica 1", replica=1)
+        for p, h in zip(prompts, handles):
+            toks, reason = h.result(timeout=120)
+            assert reason in ("eos", "length")
+            want = np.asarray(generate_cached(params, cfg, p, 12))
+            np.testing.assert_array_equal(np.asarray(toks),
+                                          want[0, p.size:])
+        # the nudge was consumed (by replica 1's loop, the only claimant)
+        assert not srv._nudges
+    finally:
+        srv.stop()
+
+
+# -- bench artifact (slow lane) -----------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_spec_fast_structure(tmp_path):
+    """tools/bench_spec.py --fast end-to-end: the artifact must carry the
+    fields BENCH_spec.json promises (legs, accept sweep, acceptance)."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from tools.bench_spec import main as bench_main
+
+    out = tmp_path / "BENCH_spec.json"
+    result = bench_main(["--fast", "--out", str(out)])
+    assert out.exists()
+    assert result["baseline"]["tokens_per_s"] > 0
+    assert result["speculative"]["tokens_per_s"] > 0
+    assert result["speculative"]["accept_rate"] is not None
+    assert len(result["accept_sweep"]) >= 2
+    tt = result["ttft_under_load"]["p99_s"]
+    assert all(tt[k] > 0 for k in ("baseline", "overlap_only",
+                                   "spec_overlap"))
+    assert result["acceptance"]["required"]

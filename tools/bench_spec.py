@@ -1,0 +1,279 @@
+"""BENCH_spec: speculative decoding + overlapped prefill vs the plain tick.
+
+Two questions, one artifact:
+
+1. **tokens/s at high accept.** A shallow draft proposes ``k`` tokens per
+   cycle and the target scores all ``k+1`` positions in ONE dispatch, so
+   the engine pays one program launch + one readback for what the
+   baseline spreads over ``k+1`` ticks. The high-accept workload is
+   constructed, not assumed: the target's layers past the draft's depth
+   have their residual contributions scaled by ``eps`` (attention/output
+   and ffn_output projections), so at ``eps -> 0`` the truncated draft
+   agrees with the target almost everywhere while the target still pays
+   its full depth per verify — the regime a distilled draft buys on a
+   real model. The accept sweep scales ``eps`` back up to honest
+   disagreement (``eps=1`` is the unmodified random target, accept ~0.1,
+   speculation near break-even) so the artifact shows how the win decays
+   with accept rate instead of hiding it.
+
+2. **TTFT p99 under admission load.** Open-queue, prefill-heavy workload
+   (long prompts, short outputs, every slot churning): the speculative
+   engine with ``overlap_prefill=True`` against the plain lockstep
+   baseline at EQUAL pool memory. Higher tokens/s drains the backlog
+   faster and overlap stops admission from idling the device between the
+   prefill readback and the decode dispatch — together they cut the p99
+   wait to first token. The overlap-only A/B is recorded too; on the CPU
+   sim its host/device pipelining is within run-to-run noise (the
+   mechanism eliminates DEVICE idle, which the simulated single-core
+   device barely has — same caveat PR 7 recorded for TP wins), so the
+   gate is the ladder's ends, not the noisy middle.
+
+Every engine is WARMED on the full workload first (compile time out of
+the measured window — steady-state serving is the regime of interest),
+then measured on a fresh metrics object. The speculative leg's extra
+draft-cache bytes are recorded (halved under ``cache_dtype=bfloat16``,
+also recorded).
+
+Usage: python tools/bench_spec.py [--fast] [--out BENCH_spec.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _build_model(num_layers: int, draft_layers: int, eps: float, seed: int = 0):
+    """A random target whose layers past ``draft_layers`` contribute
+    residuals scaled by ``eps`` — the knob that turns draft agreement
+    from ~1 (eps=0) down to whatever two random stacks give (eps=1)."""
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=num_layers,
+                    num_heads=2, intermediate_size=64,
+                    max_position_embeddings=128, dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(jax.random.PRNGKey(seed),
+                         {"input_ids": np.zeros((1, 8), np.int32)})
+    if eps != 1.0:
+        p = params["params"]
+        for i in range(draft_layers, num_layers):
+            lp = p[f"layer_{i}"]
+            for node, key in ((lp["attention"], "output"),
+                              (lp, "ffn_output")):
+                leaf = node[key]
+                node[key] = {"kernel": leaf["kernel"] * eps,
+                             "bias": leaf["bias"] * eps}
+    return cfg, params
+
+
+def _closed_run(engine, prompts, max_new: int) -> float:
+    """One closed-load pass: submit everything (queue permitting), drain.
+    Returns the wall seconds."""
+    from gradaccum_tpu.serving import QueueFull
+
+    pending = list(prompts)
+    t0 = time.perf_counter()
+    while pending or not engine.idle:
+        while pending:
+            try:
+                engine.submit(pending[0], max_new)
+            except QueueFull:
+                break
+            pending.pop(0)
+        engine.step()
+    return time.perf_counter() - t0
+
+
+def _measure(engine, prompts, max_new: int, repeats: int = 2) -> dict:
+    """Warm on the full workload (compiles + caches out of the window),
+    then take the best of ``repeats`` measured passes on fresh metrics."""
+    from gradaccum_tpu.serving import ServingMetrics
+
+    _closed_run(engine, prompts, max_new)  # warmup: compile everything
+    best = None
+    for _ in range(repeats):
+        engine.metrics = ServingMetrics()
+        dt = _closed_run(engine, prompts, max_new)
+        tps = engine.metrics.tokens_emitted / dt
+        if best is None or tps > best["tokens_per_s"]:
+            s = engine.metrics.ttft.summary()
+            best = {
+                "tokens_per_s": round(tps, 1),
+                "tokens_emitted": engine.metrics.tokens_emitted,
+                "wall_s": round(dt, 4),
+                "ttft_p50_s": s["p50"],
+                "ttft_p99_s": s["p99"],
+                "accept_rate": engine.metrics.spec_accept_rate(),
+            }
+    best["decode_programs"] = engine.decode_compile_count()
+    return best
+
+
+def run(fast: bool = False) -> dict:
+    from gradaccum_tpu.models.gpt_decode import truncate_draft_params
+    from gradaccum_tpu.serving import Engine
+
+    num_layers, draft_layers, spec_k = 4, 1, 4
+    num_slots, max_len, page_size = 4, 64, 8
+    num_blocks = num_slots * max_len // page_size
+    pool_kw = dict(num_slots=num_slots, max_len=max_len,
+                   page_size=page_size, num_blocks=num_blocks)
+    n_req = 12 if fast else 32
+    max_new = 16 if fast else 24
+    repeats = 2 if fast else 3
+    rng = np.random.default_rng(0)
+
+    def make_prompts(n, lo, hi):
+        return [rng.integers(0, 96, int(rng.integers(lo, hi + 1)))
+                .astype(np.int32) for _ in range(n)]
+
+    # -- tokens/s: baseline vs speculative at equal pool memory ----------
+    cfg, params = _build_model(num_layers, draft_layers, eps=0.02)
+    dparams, dcfg = truncate_draft_params(params, cfg, draft_layers)
+    spec_kw = dict(speculate_k=spec_k, draft_params=dparams, draft_cfg=dcfg)
+    prompts = make_prompts(n_req, 6, 16)
+
+    base_leg = _measure(Engine(params, cfg, **pool_kw), prompts, max_new,
+                        repeats)
+    spec_engine = Engine(params, cfg, **spec_kw, **pool_kw)
+    spec_leg = _measure(spec_engine, prompts, max_new, repeats)
+    speedup = spec_leg["tokens_per_s"] / base_leg["tokens_per_s"]
+
+    draft_cache_bytes = int(np.prod(spec_engine._draft_k.shape)) * 2 \
+        * jnp.dtype(spec_engine._draft_k.dtype).itemsize
+    bf16 = Engine(params, cfg, cache_dtype=jnp.bfloat16, **spec_kw, **pool_kw)
+    draft_cache_bytes_bf16 = int(np.prod(bf16._draft_k.shape)) * 2 \
+        * jnp.dtype(bf16._draft_k.dtype).itemsize
+
+    # -- accept-rate sweep: the win as draft agreement decays ------------
+    sweep = []
+    for eps in ([0.02, 1.0] if fast else [0.02, 0.2, 0.5, 1.0]):
+        cfg_e, params_e = _build_model(num_layers, draft_layers, eps=eps)
+        dparams_e, dcfg_e = truncate_draft_params(params_e, cfg_e,
+                                                  draft_layers)
+        sp = make_prompts(max(8, n_req // 2), 6, 16)
+        sweep_reps = 1 if fast else 2
+        b = _measure(Engine(params_e, cfg_e, **pool_kw), sp, max_new,
+                     sweep_reps)
+        s = _measure(
+            Engine(params_e, cfg_e, speculate_k=spec_k,
+                   draft_params=dparams_e, draft_cfg=dcfg_e, **pool_kw),
+            sp, max_new, sweep_reps)
+        sweep.append({
+            "eps": eps,
+            "accept_rate": (None if s["accept_rate"] is None
+                            else round(s["accept_rate"], 4)),
+            "tokens_per_s": s["tokens_per_s"],
+            "speedup_vs_baseline": round(
+                s["tokens_per_s"] / b["tokens_per_s"], 3),
+        })
+
+    # -- TTFT p99 under load: lockstep baseline vs spec+overlap ----------
+    # prefill-heavy open queue: long prompts, short outputs, interleaved
+    # trials so ambient machine noise hits every leg alike
+    tt_prompts = make_prompts(24 if fast else 48, 40, 56)
+    tt_new = 8
+    legs = {
+        "baseline": Engine(params, cfg, **pool_kw),
+        "overlap_only": Engine(params, cfg, overlap_prefill=True, **pool_kw),
+        "spec_overlap": Engine(params, cfg, overlap_prefill=True,
+                               **spec_kw, **pool_kw),
+    }
+    tt = {name: [] for name in legs}
+    for name, eng in legs.items():
+        _closed_run(eng, tt_prompts, tt_new)  # warm
+    from gradaccum_tpu.serving import ServingMetrics
+    for _ in range(repeats):
+        for name, eng in legs.items():
+            eng.metrics = ServingMetrics()
+            _closed_run(eng, tt_prompts, tt_new)
+            tt[name].append(eng.metrics.ttft.summary()["p99"])
+    p99 = {name: min(vals) for name, vals in tt.items()}
+
+    passed = (speedup >= 1.4
+              and p99["spec_overlap"] < p99["baseline"]
+              and base_leg["decode_programs"] == 1
+              and spec_leg["decode_programs"] == 1)
+    result = {
+        "bench": "speculative decoding (draft k + single-dispatch verify) "
+                 "+ overlapped prefill, equal pool memory",
+        "model": {"num_layers": num_layers, "hidden": cfg.hidden_size,
+                  "heads": cfg.num_heads, "vocab": cfg.vocab_size,
+                  "draft_layers": draft_layers, "eps": 0.02},
+        "workload": {"requests": n_req, "max_new": max_new,
+                     "num_slots": num_slots, "max_len": max_len,
+                     "page_size": page_size, "num_blocks": num_blocks,
+                     "speculate_k": spec_k, "fast": fast},
+        "baseline": base_leg,
+        "speculative": spec_leg,
+        "speedup": round(speedup, 3),
+        "accept_sweep": sweep,
+        "ttft_under_load": {
+            "workload": {"requests": len(tt_prompts),
+                         "prompt_len": "40-56", "max_new": tt_new},
+            "p99_s": {k: round(v, 5) for k, v in p99.items()},
+            "spec_overlap_vs_baseline": round(
+                p99["spec_overlap"] / p99["baseline"], 3),
+            "overlap_only_vs_baseline": round(
+                p99["overlap_only"] / p99["baseline"], 3),
+            "note": "overlap-only is within CPU-sim noise (it removes "
+                    "DEVICE idle between prefill readback and decode "
+                    "dispatch; the simulated device has little) — the "
+                    "gated claim is the ladder's ends",
+            "trials": {k: [round(v, 5) for v in vals]
+                       for k, vals in tt.items()},
+        },
+        "draft_cache_bytes": draft_cache_bytes,
+        "draft_cache_bytes_bf16": draft_cache_bytes_bf16,
+        "headline": (
+            f"spec {speedup:.2f}x tokens/s at accept "
+            f"{spec_leg['accept_rate']:.2f}; TTFT p99 under load "
+            f"{p99['spec_overlap'] / p99['baseline']:.2f}x of baseline"
+        ),
+        "acceptance": {
+            "required": "spec >= 1.4x tokens/s on the high-accept "
+                        "workload, spec+overlap TTFT p99 < lockstep "
+                        "baseline under load, decode_programs == 1 both "
+                        "legs",
+            "passed": bool(passed),
+        },
+    }
+    result["platform"] = {
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "cpu_count": os.cpu_count(),
+    }
+    return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fast", action="store_true",
+                    help="small shapes for CI (structure, not headline)")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir,
+        "BENCH_spec.json"))
+    args = ap.parse_args(argv)
+    result = run(fast=args.fast)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"{result['headline']}; acceptance passed="
+          f"{result['acceptance']['passed']}")
+    print(f"wrote {args.out}")
+    return result
+
+
+if __name__ == "__main__":
+    main()

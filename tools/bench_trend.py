@@ -57,6 +57,24 @@ def _overhead_column(data) -> str:
     return "overhead " + ", ".join(parts)
 
 
+def _spec_column(data) -> str:
+    """Render an ``accept_sweep`` list (BENCH_spec.json) as the
+    speedup-vs-accept-rate decay curve — the one number sweep operators
+    tune k against."""
+    sweep = data.get("accept_sweep")
+    if not isinstance(sweep, list) or not sweep:
+        return ""
+    try:
+        parts = [
+            f"acc {float(s['accept_rate']):.2f}: "
+            f"{float(s['speedup_vs_baseline']):.2f}x"
+            for s in sweep
+        ]
+    except (KeyError, TypeError, ValueError):
+        return ""
+    return "accept sweep " + ", ".join(parts)
+
+
 def _memory_column(data) -> str:
     """Render a mixed-precision ``rows`` ladder (BENCH_mixed.json) as the
     per-replica optimizer+accumulator bytes/param progression."""
@@ -103,6 +121,7 @@ def collect(bench_dir: str):
             "scaling": _scaling_column(data) or None,
             "overhead": _overhead_column(data) or None,
             "memory": _memory_column(data) or None,
+            "spec": _spec_column(data) or None,
             "acceptance": acceptance,
             "passed": None if acceptance is None
             else bool(acceptance.get("passed")),
@@ -169,6 +188,8 @@ def main(argv=None) -> int:
                 detail += f" — {r['overhead']}"
             if r.get("memory"):
                 detail += f" — {r['memory']}"
+            if r.get("spec"):
+                detail += f" — {r['spec']}"
             if required != "":
                 detail += f" [{required}]"
             if not r["passed"]:
