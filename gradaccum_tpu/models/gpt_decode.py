@@ -436,6 +436,46 @@ def init_paged_pool(cfg: GPTConfig, num_blocks: int, page_size: int,
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
+@jax.jit
+def _gather_blocks(pool_k, pool_v, block_ids):
+    return pool_k[:, block_ids], pool_v[:, block_ids]
+
+
+def gather_blocks(pool_k, pool_v, block_ids):
+    """Whole-block device→host staging gather for swap-OUT: returns
+    ``(k, v)`` each ``[L, n, H, page, hd]`` for the ``n`` requested block
+    ids. One jitted program per bucketed id count — callers (the serving
+    engine's preemption path) pad ``block_ids`` to a power of two and
+    slice host-side, so the compile count stays bounded by the bucket
+    set, never by traffic. Out-of-range ids (the padding) clamp under
+    jit gather semantics; their rows are garbage the caller drops."""
+    return _gather_blocks(pool_k, pool_v, jnp.asarray(block_ids, jnp.int32))
+
+
+def _make_scatter():
+    def scatter(pool_k, pool_v, block_ids, k_blocks, v_blocks):
+        pool_k = pool_k.at[:, block_ids].set(k_blocks.astype(pool_k.dtype))
+        pool_v = pool_v.at[:, block_ids].set(v_blocks.astype(pool_v.dtype))
+        return pool_k, pool_v
+
+    return jax.jit(scatter, donate_argnums=(0, 1))
+
+
+_scatter_blocks = _make_scatter()
+
+
+def scatter_blocks(pool_k, pool_v, block_ids, k_blocks, v_blocks):
+    """The swap-IN twin of :func:`gather_blocks`: write ``n`` host-staged
+    blocks into the pool at ``block_ids`` (the pool buffers are DONATED —
+    the restore updates in place, it never doubles the pool). Padding ids
+    use the sentinel ``num_blocks``: out-of-bounds scatter updates are
+    dropped, so a padded row writes nothing. Same bucketed compile-once
+    discipline as the gather."""
+    return _scatter_blocks(pool_k, pool_v,
+                           jnp.asarray(block_ids, jnp.int32),
+                           k_blocks, v_blocks)
+
+
 def decode_step_paged(params, cfg: GPTConfig, pool_k, pool_v, page_table,
                       lengths, token, active=None, limit=None):
     """One cached step against the PAGED pool: like

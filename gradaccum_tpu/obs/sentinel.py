@@ -20,7 +20,13 @@ a tick-heartbeat lease per replica, and detects four anomaly classes:
 - ``degenerate_draft`` — a speculative engine's draft accept rate pinned
   below the floor: speculation has become pure overhead (the per-replica
   accept-rate feed comes from the serving loop, so one replica's stale
-  draft is visible even when the fleet average looks fine).
+  draft is visible even when the fleet average looks fine);
+- ``preemption_storm`` — an admission-policy engine's windowed preemption
+  rate pinned above the ceiling: optimistic admission is thrashing (every
+  admitted request evicts another — swap/re-prefill churn instead of
+  tokens). The policy's own governor backs admission off first; this
+  anomaly is the fleet-visible escalation, and its stock remediation
+  routes the replica through recover + bounded requeue.
 
 Every NEW anomaly lands as a ``sentinel/anomaly`` span event, a flight
 recorder dump (``sentinel-<kind>``), and a registry counter bump, then
@@ -53,9 +59,10 @@ LATENCY_CLIFF = "latency_cliff"
 SCALE_STORM = "scale_storm"
 ENGINE_FAULT = "engine_fault"
 DEGENERATE_DRAFT = "degenerate_draft"
+PREEMPTION_STORM = "preemption_storm"
 
 KINDS = (STALL, DEAD_REPLICA, LATENCY_CLIFF, SCALE_STORM, ENGINE_FAULT,
-         DEGENERATE_DRAFT)
+         DEGENERATE_DRAFT, PREEMPTION_STORM)
 
 
 class RollingBaseline:
@@ -145,6 +152,9 @@ class Sentinel:
         accept_floor: float = 0.1,
         accept_warmup: int = 8,
         accept_consecutive: int = 8,
+        preempt_ceiling: float = 0.5,
+        preempt_warmup: int = 8,
+        preempt_consecutive: int = 8,
         check_interval: Optional[float] = None,
     ):
         if clock is None:
@@ -163,6 +173,9 @@ class Sentinel:
         self.accept_floor = float(accept_floor)
         self.accept_warmup = int(accept_warmup)
         self.accept_consecutive = int(accept_consecutive)
+        self.preempt_ceiling = float(preempt_ceiling)
+        self.preempt_warmup = int(preempt_warmup)
+        self.preempt_consecutive = int(preempt_consecutive)
         self.check_interval = check_interval
         self._lock = threading.Lock()
         # replica key (None = the single engine) -> lease state
@@ -172,6 +185,8 @@ class Sentinel:
         self._scales: deque = deque()  # (t, scale)
         self._accept_n: Dict[Optional[int], int] = {}
         self._accept_run: Dict[Optional[int], int] = {}
+        self._preempt_n: Dict[Optional[int], int] = {}
+        self._preempt_run: Dict[Optional[int], int] = {}
         self._remedies: Dict[str, List[Callable[[Anomaly], None]]] = {}
         self._firing: Dict[Tuple[str, Optional[int]], Anomaly] = {}
         self.anomalies: List[Anomaly] = []  # the log (fire + resolve)
@@ -344,6 +359,35 @@ class Sentinel:
                         "floor": self.accept_floor}, t)
         elif not low:
             self._resolve(DEGENERATE_DRAFT, replica, t)
+
+    def observe_preemptions(self, rate: Optional[float],
+                            replica: Optional[int] = None,
+                            now: Optional[float] = None) -> None:
+        """Feed one admission-policy engine's recent preemption rate
+        (preemptions/tick over the serving metrics' 64-tick window; None
+        = no admission plane, ignored). A rate pinned above
+        ``preempt_ceiling`` for ``preempt_consecutive`` warmed samples
+        fires ``preemption_storm`` — the pool is churning evictions
+        instead of emitting tokens, and an operator should grow blocks,
+        raise the quantile, or fall back to reserve admission. Recovery
+        below the ceiling auto-resolves, same level-held contract as
+        every other kind."""
+        if rate is None:
+            return
+        t = self.clock() if now is None else float(now)
+        with self._lock:
+            n = self._preempt_n.get(replica, 0) + 1
+            self._preempt_n[replica] = n
+            high = (n > self.preempt_warmup
+                    and float(rate) > self.preempt_ceiling)
+            run = self._preempt_run.get(replica, 0) + 1 if high else 0
+            self._preempt_run[replica] = run
+        if high and run >= self.preempt_consecutive:
+            self._fire(PREEMPTION_STORM, replica,
+                       {"preemption_rate": round(float(rate), 4),
+                        "ceiling": self.preempt_ceiling}, t)
+        elif not high:
+            self._resolve(PREEMPTION_STORM, replica, t)
 
     def note_fault(self, error: str = "", replica: Optional[int] = None,
                    now: Optional[float] = None) -> None:

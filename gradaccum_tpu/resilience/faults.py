@@ -20,6 +20,13 @@ Fault points (the ``index`` each site passes):
   (the sweep test), ``io_error`` exercises retry-with-backoff.
 - ``MID_DECODE_TICK`` — inside the serving engine's tick, after admission
   and before the decode dispatch; index = tick count.
+- ``MID_SWAP_IO`` — inside the host swap store's put/get (serving
+  preemption); index = request id. ``io_error`` here exercises the
+  engine's swap-fallback path (drop the swap, re-prefill on re-admission).
+- ``POOL_PAGE_TABLE`` — before a paged tick's dispatch; index = tick
+  count. The ``corrupt`` kind pokes an out-of-range block id into a live
+  page-table row; the pool's upload-time bounds check turns it into a
+  structured engine fault the recover/requeue contract heals.
 
 Kinds: ``crash`` raises :class:`InjectedCrash` (simulated process death —
 deliberately NOT an OSError, so IO retry loops never swallow it);
@@ -31,7 +38,10 @@ successive indices from ``at``) — the systematic-overflow scenario that
 exercises dynamic loss-scale halving and all-bad windows, seeded via
 :meth:`FaultSchedule.overflow_storm`; ``slow_tick`` sleeps ``delay``
 seconds at the fault point (a wedged-but-not-dead dispatch — what the
-serving watchdog exists to break) and then lets the call proceed.
+serving watchdog exists to break) and then lets the call proceed;
+``corrupt`` returns the kind string for the call site to corrupt its own
+state (the paged engine pokes a page-table row — bookkeeping corruption,
+as opposed to the data corruption of ``nan``/``inf``).
 
 When no injector is installed every hook is one global load + compare —
 nothing here touches the hot path in production.
@@ -51,7 +61,10 @@ PRE_TRAIN_STEP = "pre_train_step"
 POST_TRAIN_STEP = "post_train_step"
 MID_CKPT_WRITE = "mid_checkpoint_write"
 MID_DECODE_TICK = "mid_decode_tick"
-POINTS = (PRE_TRAIN_STEP, POST_TRAIN_STEP, MID_CKPT_WRITE, MID_DECODE_TICK)
+MID_SWAP_IO = "mid_swap_io"
+POOL_PAGE_TABLE = "pool_page_table"
+POINTS = (PRE_TRAIN_STEP, POST_TRAIN_STEP, MID_CKPT_WRITE, MID_DECODE_TICK,
+          MID_SWAP_IO, POOL_PAGE_TABLE)
 
 KIND_CRASH = "crash"
 KIND_IO_ERROR = "io_error"
@@ -59,8 +72,9 @@ KIND_NAN = "nan"
 KIND_INF = "inf"
 KIND_OVERFLOW_STORM = "overflow_storm"
 KIND_SLOW_TICK = "slow_tick"
+KIND_CORRUPT = "corrupt"
 KINDS = (KIND_CRASH, KIND_IO_ERROR, KIND_NAN, KIND_INF,
-         KIND_OVERFLOW_STORM, KIND_SLOW_TICK)
+         KIND_OVERFLOW_STORM, KIND_SLOW_TICK, KIND_CORRUPT)
 # kinds whose firing corrupts the caller's data via corrupt_batch
 DATA_KINDS = (KIND_NAN, KIND_INF, KIND_OVERFLOW_STORM)
 

@@ -62,14 +62,22 @@ def bind_default_remediations(sentinel, server=None, consensus=None):
     ``latency_cliff``         ``server`` recover + bounded requeue
     ``stall``                 ``server`` recover + bounded requeue
     ``dead_replica``          ``server`` recover + bounded requeue
+    ``preemption_storm``      ``server`` recover + bounded requeue
     ``scale_storm``           ``consensus`` drain request
     ``engine_fault``          (none — the fault handler already ran)
     ========================= =====================================
+
+    ``preemption_storm`` rides the same recover path on purpose: a pool
+    churning evictions holds half-finished streams hostage; recover
+    releases every slot and the bounded requeue replays them through the
+    (by then governed) admission gate — the serving analogue of draining
+    a thrashing scheduler.
     """
     if server is not None:
         remedy = recover_and_requeue(server)
         for kind in (obs_sentinel.LATENCY_CLIFF, obs_sentinel.STALL,
-                     obs_sentinel.DEAD_REPLICA):
+                     obs_sentinel.DEAD_REPLICA,
+                     obs_sentinel.PREEMPTION_STORM):
             sentinel.on(kind, remedy)
     if consensus is not None:
         sentinel.on(obs_sentinel.SCALE_STORM, request_drain(consensus))

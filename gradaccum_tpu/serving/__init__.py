@@ -25,8 +25,15 @@ mode preserves the target distribution via rejection sampling.
 ``overlap_prefill=True`` enqueues admission prefill and the decode tick
 before any readback so the device rolls straight from one into the
 other; ``cache_dtype=jnp.bfloat16`` halves KV-pool (and draft-cache)
-bytes. Admission
-control with backpressure and deadlines lives in ``scheduler``; a threaded
+bytes. ``Engine(admission="quantile"|"optimistic")`` replaces worst-case
+reservations with the admission control plane (``admission`` +
+``swap``): length-quantile or one-page budgets overcommit the block
+pool, mid-stream ``PoolPressure`` preempts a refcount/prefix-liveness
+scored victim whose blocks swap to host memory (sha-checked round trip)
+or re-prefill at re-admission, parked requests resume ahead of fresh
+traffic token-for-token identical, and a thrash governor plus the
+``preemption_storm`` sentinel anomaly bound the churn. Admission
+queueing with backpressure and deadlines lives in ``scheduler``; a threaded
 front-end plus a deterministic seeded simulation driver in ``server``
 (``ServingServer(free_running=True)`` runs one loop thread per replica of
 a fleet); TTFT / throughput / occupancy / speculative-accept telemetry in
@@ -38,12 +45,18 @@ data-parallel engines — least-loaded dispatch, prefix-affinity routing,
 per-replica failure domains — behind the same server surface.
 """
 
+from gradaccum_tpu.serving.admission import (
+    AdmissionPolicy,
+    LengthQuantileEstimator,
+)
 from gradaccum_tpu.serving.cache_pool import (
     CachePool,
     PagedCachePool,
+    PoolPressure,
     PrefixCache,
 )
 from gradaccum_tpu.serving.engine import Engine, StepEvents
+from gradaccum_tpu.serving.swap import HostSwapStore, SwapError
 from gradaccum_tpu.serving.metrics import ServingMetrics
 from gradaccum_tpu.serving.replicated import ReplicatedEngine
 from gradaccum_tpu.serving.scheduler import QueueFull, Request, Scheduler
@@ -54,9 +67,14 @@ from gradaccum_tpu.serving.server import (
 )
 
 __all__ = [
+    "AdmissionPolicy",
+    "LengthQuantileEstimator",
     "CachePool",
+    "HostSwapStore",
     "PagedCachePool",
+    "PoolPressure",
     "PrefixCache",
+    "SwapError",
     "Engine",
     "StepEvents",
     "ReplicatedEngine",

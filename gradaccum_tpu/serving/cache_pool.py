@@ -60,6 +60,35 @@ from gradaccum_tpu.models.gpt_decode import (
 )
 
 
+class PoolPressure(RuntimeError):
+    """Structured mid-stream allocation failure: a slot needed blocks the
+    free list could not supply. Impossible by construction under the
+    worst-case reservation gate; with an :class:`~gradaccum_tpu.serving.
+    admission.AdmissionPolicy` overcommitting (``allow_overcommit``), it
+    is the engine's signal to preempt a victim and retry — never a crash.
+    Carries the numbers the victim policy and the operator both need."""
+
+    def __init__(self, slot: int, need_blocks: int, free_blocks: int,
+                 reserved_blocks: int):
+        super().__init__(
+            f"slot {slot} needs {need_blocks} more block(s) but the pool "
+            f"has {free_blocks} free ({reserved_blocks} reserved to the "
+            "slot) — preempt a victim or shrink admission optimism"
+        )
+        self.slot = int(slot)
+        self.need_blocks = int(need_blocks)
+        self.free_blocks = int(free_blocks)
+        self.reserved_blocks = int(reserved_blocks)
+
+
+class BlockTableCorruption(RuntimeError):
+    """A page-table row holds an id outside ``[0, num_blocks]`` — host
+    bookkeeping corruption (the chaos suite injects it via the
+    ``pool_page_table`` fault point). Raised at upload time so the bad
+    table never reaches a compiled program; the serving fault contract
+    (recover → requeue) heals it by releasing and replaying the slots."""
+
+
 class _SlotLedger:
     """Host-side slot claim/release bookkeeping shared by both pools:
     deterministic lowest-slot-first ordering, claim/release validation,
@@ -210,6 +239,13 @@ class PrefixCache:
             self._by_hash[key] = block
             self._by_block[block] = key
 
+    def is_live(self, block: int) -> bool:
+        """Whether ``block`` currently backs an indexed prompt chunk — the
+        victim policy's "hot prefix" signal (evicting its holder forfeits
+        future prefill savings, so such a slot is never the cheap
+        victim)."""
+        return int(block) in self._by_block
+
     def forget_block(self, block: int) -> None:
         """Drop the entry backed by ``block`` (the pool calls this when the
         block's refcount hits zero — its contents are about to be reused)."""
@@ -291,6 +327,11 @@ class PagedCachePool(_SlotLedger):
         # allocated it); None once that slot released while sharers remain
         self._block_owner: List[Optional[int]] = [None] * num_blocks
         self._orphans = 0  # live blocks covered by no reservation
+        # an AdmissionPolicy engine flips this: alloc_to may then grow a
+        # slot PAST its reservation (optimistic admission) and an empty
+        # free list raises the structured PoolPressure signal instead of
+        # tripping the impossible-by-construction invariant
+        self.allow_overcommit = False
 
     def release(self, slot: int) -> None:
         """Free the slot, DECREF its blocks (freeing only those that hit
@@ -349,6 +390,28 @@ class PagedCachePool(_SlotLedger):
         counter maintained at incref/decref (the engine samples this every
         tick)."""
         return self._shared_count
+
+    @property
+    def admittable_blocks(self) -> int:
+        """What an OVERCOMMITTING admission gate may promise: bounded by
+        reservations (like ``unreserved_blocks``) AND by what is actually
+        free right now — under overcommit, allocation can outrun
+        reservations, so unreserved alone would promise blocks the free
+        list no longer holds."""
+        return min(self.unreserved_blocks, self.free_blocks)
+
+    def blocks_of(self, slot: int) -> List[int]:
+        """The slot's mapped block ids in page order (a copy — victim
+        scoring and swap-out read it, never mutate it)."""
+        return list(self._slot_blocks[slot])
+
+    def refcount(self, block: int) -> int:
+        return self._block_refs[int(block)]
+
+    def owner_of(self, block: int) -> Optional[int]:
+        """The slot whose reservation covers ``block`` (None for free or
+        orphaned blocks)."""
+        return self._block_owner[int(block)]
 
     @property
     def token_capacity(self) -> int:
@@ -411,14 +474,22 @@ class PagedCachePool(_SlotLedger):
         allocated blocks start at refcount 1, owned by this slot."""
         need = min(self.blocks_for(tokens), self.max_pages)
         have = len(self._slot_blocks[slot])
-        if need - self._slot_shared[slot] > self._slot_reserved[slot]:
+        if (not self.allow_overcommit
+                and need - self._slot_shared[slot] > self._slot_reserved[slot]):
             raise ValueError(
                 f"slot {slot} needs {need - self._slot_shared[slot]} private "
                 f"blocks but reserved only {self._slot_reserved[slot]} — the "
                 "write limit should have made this unreachable"
             )
         for page in range(have, need):
-            block = self._free_blocks.pop()  # reservation guarantees supply
+            if not self._free_blocks:
+                # only reachable under overcommit (the reservation gate
+                # guarantees supply otherwise); blocks granted before the
+                # shortfall stay mapped — the engine preempts a victim and
+                # re-calls, resuming from the grown extent
+                raise PoolPressure(slot, need - page, 0,
+                                   self._slot_reserved[slot])
+            block = self._free_blocks.pop()
             self._block_refs[block] = 1
             self._block_owner[block] = slot
             self._slot_blocks[slot].append(block)
@@ -430,8 +501,21 @@ class PagedCachePool(_SlotLedger):
         """Device copy of the page table, memoized: re-uploaded only after
         a mutation (``alloc_to`` growth, ``adopt_shared``, ``release``) —
         steady-state decode ticks reuse the same device buffer instead of
-        paying a host→device transfer per tick."""
+        paying a host→device transfer per tick. Re-uploads bounds-check
+        the host table first (vectorized, mutation ticks only): a
+        corrupted id must fault HERE, structured, not gather garbage
+        blocks into some request's attention."""
         if self._table_device is None:
+            if ((self.page_table < 0) | (self.page_table > self.num_blocks)
+                    ).any():
+                bad = np.argwhere((self.page_table < 0)
+                                  | (self.page_table > self.num_blocks))[0]
+                raise BlockTableCorruption(
+                    f"page table holds out-of-range block id "
+                    f"{int(self.page_table[tuple(bad)])} at slot "
+                    f"{int(bad[0])} page {int(bad[1])} "
+                    f"(valid ids are 0..{self.num_blocks})"
+                )
             table = jnp.asarray(self.page_table)
             if self.table_sharding is not None:
                 table = jax.device_put(table, self.table_sharding)

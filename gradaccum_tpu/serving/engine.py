@@ -40,22 +40,27 @@ from gradaccum_tpu.models.gpt_decode import (
     _top_k_mask,
     decode_step_paged,
     decode_step_ragged,
+    gather_blocks,
     init_cache,
     prefill,
     prefill_paged,
     sample_token,
+    scatter_blocks,
     verify_step_paged,
     verify_step_ragged,
 )
 from gradaccum_tpu.obs import trace as obs_trace
 from gradaccum_tpu.resilience import faults
+from gradaccum_tpu.serving import admission as admission_lib
 from gradaccum_tpu.serving.cache_pool import (
     CachePool,
     PagedCachePool,
+    PoolPressure,
     PrefixCache,
 )
 from gradaccum_tpu.serving.metrics import ServingMetrics
 from gradaccum_tpu.serving.scheduler import QueueFull, Request, Scheduler
+from gradaccum_tpu.serving.swap import HostSwapStore, SwapError
 from gradaccum_tpu.utils.profiling import StepWindowProfiler
 
 
@@ -67,6 +72,39 @@ class StepEvents:
     finished: List[Tuple[int, str]]   # (request_id, reason: eos|length|timeout)
     admitted: List[int]               # request_ids prefilled this tick
     tick: int
+    # admission-control lifecycle (empty for reserve-gated engines):
+    preempted: List[int] = dataclasses.field(default_factory=list)
+    resumed: List[int] = dataclasses.field(default_factory=list)
+
+
+def _block_bucket(n: int) -> int:
+    """Power-of-two bucket for swap gather/scatter block-id counts — ONE
+    definition for both directions, so the swap-out gather and swap-in
+    scatter program sets stay bounded by the same bucket ladder and can
+    never silently diverge."""
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+@dataclasses.dataclass
+class _ParkedState:
+    """Everything needed to resume a preempted slot token-for-token:
+    the per-slot device state snapshotted host-side at preemption, plus
+    (swap mode) a reference into the host block store. ``generated`` is
+    the emitted-token count at preemption — together with lengths/gen it
+    pins the exact resume point for both restore paths."""
+
+    request: Request
+    generated: int
+    cur_tok: int
+    gen_count: int
+    rng_key: np.ndarray
+    length: int
+    limit: int
+    swapped: bool           # a HostSwapStore record exists for this rid
+    page_start: int         # leading pages that were shared-prefix blocks
 
 
 def _make_tick_fn(cfg: GPTConfig, temperature: float, top_k, block: int):
@@ -514,6 +552,28 @@ class Engine:
     system prompts then cost one set of blocks total and a suffix-sized
     prefill per request; outputs are token-for-token unchanged (the parity
     gate in tests/test_serving_prefix.py).
+
+    ``admission`` (paged mode for the overcommitting modes) replaces the
+    worst-case reservation gate with an
+    :class:`~gradaccum_tpu.serving.admission.AdmissionPolicy` (or one of
+    its mode strings ``"reserve"`` / ``"quantile"`` / ``"optimistic"``):
+    requests reserve a length-quantile (or one-page) budget instead of
+    ``prompt + max_new_tokens``, so concurrency tracks how long requests
+    ACTUALLY run. The pool may then run dry mid-stream — allocation
+    raises the structured :class:`~gradaccum_tpu.serving.cache_pool.
+    PoolPressure` and the engine preempts the cheapest victim
+    (refcount/prefix-liveness scored: blocks shared by N slots or hot in
+    the PrefixCache are never the cheap choice), parks it ahead of all
+    fresh admissions, and re-admits it when blocks free up — restored
+    either from the host block store (``swap="host"``: private blocks
+    gathered out in block units, sha-checked back in) or by
+    re-prefilling prompt + generated-so-far (``swap="recompute"``).
+    Either way the resumed stream is token-for-token identical to an
+    uninterrupted run (greedy and seeded-sampled — the rng stream folds
+    position indices, which the resume restores exactly). A thrash
+    governor inside the policy flips budgets back to worst case when
+    preemptions storm; the ``preemption_storm`` sentinel anomaly covers
+    the fleet-level version of the same signal.
     """
 
     def __init__(
@@ -545,6 +605,8 @@ class Engine:
         draft_cfg: Optional[GPTConfig] = None,
         cache_dtype=None,
         overlap_prefill: bool = False,
+        admission=None,
+        swap: str = "host",
     ):
         if top_k is not None and temperature <= 0:
             raise ValueError("top_k sampling needs temperature > 0 "
@@ -589,6 +651,40 @@ class Engine:
         self.draft_params = draft_params if self.speculate_k else None
         self.cache_dtype = cache_dtype
         self.overlap_prefill = bool(overlap_prefill)
+        # -- admission control plane ----------------------------------
+        # None keeps the legacy gate byte-for-byte: worst-case
+        # reservations on the paged pool, slots-only on the fixed one.
+        # A policy turns on optimistic admission (paged only for the
+        # quantile/optimistic modes — overcommit is a BLOCK concept) and
+        # with it the preempt -> park -> re-admit lifecycle.
+        self.admission_policy = admission_lib.resolve_policy(admission)
+        if swap not in ("host", "recompute"):
+            raise ValueError(
+                f"swap must be 'host' or 'recompute', got {swap!r}"
+            )
+        self.swap_mode = swap
+        if (self.admission_policy is not None
+                and self.admission_policy.mode != "reserve"
+                and page_size is None):
+            raise ValueError(
+                f"admission mode {self.admission_policy.mode!r} needs "
+                "paged mode (page_size=...): overcommit is accounted in "
+                "KV blocks"
+            )
+        self._swap_store = HostSwapStore() if swap == "host" else None
+        # rid -> resume snapshot for parked (preempted) requests
+        self._parked_state: Dict[int, _ParkedState] = {}
+        # rid -> policy-budget tokens decided by this tick's admission
+        # gate, consumed by _admit_dispatch's reserve call
+        self._pending_budget: Dict[int, int] = {}
+        # the rid currently re-prefilling through _admit_dispatch as a
+        # RESUME (admission metrics must not treat it as a fresh miss)
+        self._resuming_rid: Optional[int] = None
+        # committed shardings remembered for the (rare) swap-in restore
+        # path under a serving mesh
+        self._kv_sharding = None
+        self._rep_sharding = None
+        self._dkv_sharding = None
         # truthiness is not enough: an EMPTY PrefixCache instance is falsy
         # (__len__ == 0) but is still an explicit request for sharing
         wants_prefix = bool(prefix_cache) or isinstance(prefix_cache,
@@ -609,6 +705,9 @@ class Engine:
                                        self.page_size, self.num_blocks,
                                        prefix_cache=self.prefix_cache,
                                        cache_dtype=cache_dtype)
+            if (self.admission_policy is not None
+                    and self.admission_policy.mode != "reserve"):
+                self.pool.allow_overcommit = True
         else:
             self.prefix_cache = None
             self.num_blocks = None
@@ -804,6 +903,10 @@ class Engine:
             self.pool.table_sharding = rep
         else:
             kv = NamedSharding(mesh, P(None, None, MODEL_AXIS))
+        # remembered for the swap-in restore path: scattered/rebuilt pool
+        # arrays must land back on their committed shardings
+        self._kv_sharding = kv
+        self._rep_sharding = rep
         self.pool.k = jax.device_put(self.pool.k, kv)
         self.pool.v = jax.device_put(self.pool.v, kv)
         self.pool.lengths = jax.device_put(self.pool.lengths, rep)
@@ -815,6 +918,7 @@ class Engine:
             # the draft cache is fixed layout [dL, S, HEADS, T, hd]: shard
             # the head axis, same as the fixed target pool
             dkv = NamedSharding(mesh, P(None, None, MODEL_AXIS))
+            self._dkv_sharding = dkv
             self._draft_k = jax.device_put(self._draft_k, dkv)
             self._draft_v = jax.device_put(self._draft_v, dkv)
 
@@ -838,7 +942,8 @@ class Engine:
 
     @property
     def idle(self) -> bool:
-        return self.scheduler.depth == 0 and self.pool.active_count == 0
+        return (self.scheduler.depth == 0 and self.pool.active_count == 0
+                and self.scheduler.parked_depth == 0)
 
     @property
     def tick_count(self) -> int:
@@ -889,6 +994,13 @@ class Engine:
             "cache_dtype": (None if self.cache_dtype is None
                             else jnp.dtype(self.cache_dtype).name),
             "overlap_prefill": self.overlap_prefill,
+            "admission": (None if self.admission_policy is None
+                          else self.admission_policy.mode),
+            "admission_q": (self.admission_policy.q
+                            if self.admission_policy is not None
+                            and self.admission_policy.mode == "quantile"
+                            else None),
+            "swap": self.swap_mode,
         }
 
     # -- request intake ---------------------------------------------------
@@ -990,13 +1102,25 @@ class Engine:
         if self.pool.free_count == 0:
             return tag + "no free slots"
         if self.paged:
+            policy = self.admission_policy
+            if self.scheduler.parked_depth:
+                # preempted requests re-admit ahead of everything — fresh
+                # traffic waits behind the preemption backlog by design
+                return tag + "parked requests ahead (preemption backlog)"
             # judge by what admission would actually ask for: the queue
             # head's reservation — only its UNSHARED blocks when the prefix
-            # cache would cover the rest (one page when the queue is empty)
+            # cache would cover the rest (one page when the queue is empty).
+            # Under an admission policy the ask is the POLICY's budget, and
+            # the supply is admittable_blocks (reservations AND the free
+            # list — overcommit can outrun reservations).
             head = self.scheduler.peek()
             if head is not None:
-                need = self.pool.blocks_for(head.prompt.size
-                                            + head.max_new_tokens)
+                budget = head.prompt.size + head.max_new_tokens
+                if policy is not None:
+                    budget = policy.budget_tokens(
+                        head.prompt.size, head.max_new_tokens,
+                        self.page_size, self._tick)
+                need = self.pool.blocks_for(budget)
                 if self.prefix_cache is not None:
                     memo = self._head_match_memo
                     if memo is None or memo[0] != head.request_id:
@@ -1006,7 +1130,15 @@ class Engine:
                     need -= memo[1]
             else:
                 need = 1
-            if need > self.pool.unreserved_blocks:
+            if policy is not None and policy.mode != "reserve":
+                if need > self.pool.admittable_blocks:
+                    # the policy gate is holding with blocks still free:
+                    # name the GATE, not the pool — growing num_blocks is
+                    # the wrong fix for a governed or conservative policy
+                    if self.pool.free_blocks > 0:
+                        return tag + "held by quantile gate"
+                    return tag + "no free KV blocks"
+            elif need > self.pool.unreserved_blocks:
                 return tag + "no free KV blocks"
         return tag + "queue backlog (slots available)"
 
@@ -1044,8 +1176,14 @@ class Engine:
         emitted: List[Tuple[int, int]] = []
         finished: List[Tuple[int, str]] = []
         admitted: List[int] = []
+        preempted: List[int] = []
 
         for req in self.scheduler.expire(t):
+            # a PARKED expiry also forfeits its resume state (swap record
+            # included) — it will never re-enter a slot
+            self._parked_state.pop(req.request_id, None)
+            if self._swap_store is not None:
+                self._swap_store.discard(req.request_id)
             self.status[req.request_id] = "timeout"
             finished.append((req.request_id, "timeout"))
             # a deadline expiry is a TERMINAL queue-wait observation: the
@@ -1063,7 +1201,14 @@ class Engine:
                             rid=req.request_id, outcome="timeout",
                             **self._obs_args)
 
+        # parked (preempted) requests resume STRICTLY ahead of fresh
+        # admissions — they already consumed prefill and decode work, and
+        # admitting around them is the thrash the governor exists to stop
+        resumed = self._try_resume()
+
         fits = None
+        policy = self.admission_policy
+        stall_override = [None]
         if self.paged:
             # the gate must count reservations from EARLIER requests in
             # this same admission batch (they only land in the pool inside
@@ -1072,20 +1217,65 @@ class Engine:
             self._pending_match.clear()
 
             def fits(r):
-                total = self.pool.blocks_for(r.prompt.size + r.max_new_tokens)
+                full = r.prompt.size + r.max_new_tokens
+                total = self.pool.blocks_for(full)
+                if total > self.pool.max_pages:
+                    # no policy can admit this (submit() validation makes
+                    # it unreachable in practice) — the generic stall key
+                    # stands; "held by quantile gate" would misdirect
+                    return False
                 shared = (self.prefix_cache.match(r.prompt)
                           if self.prefix_cache is not None else [])
+                if policy is None:
+                    budget = full
+                    need = total - len(shared)
+                    supply = self.pool.unreserved_blocks
+                else:
+                    # the POLICY's budget is the reservation ask; the
+                    # supply is bounded by the free list too, because
+                    # overcommitted allocation can outrun reservations
+                    budget = policy.budget_tokens(r.prompt.size,
+                                                  r.max_new_tokens,
+                                                  self.page_size, t)
+                    need = self.pool.blocks_for(budget) - len(shared)
+                    supply = self.pool.admittable_blocks
                 # a prefix hit is charged only its unshared tail — that is
                 # what reserve() will charge, so the gate stays truthful
-                need = total - len(shared)
-                if (pending[0] + need > self.pool.unreserved_blocks
-                        or total > self.pool.max_pages):
+                if pending[0] + need > supply:
+                    if (policy is not None and policy.mode != "reserve"
+                            and self.pool.free_blocks > 0):
+                        # blocks exist; the policy gate is what refused —
+                        # a distinct stall key so operators can tell a
+                        # governed/conservative gate from real exhaustion
+                        stall_override[0] = "held_by_quantile_gate"
                     return False
                 pending[0] += need
                 self._pending_match[r.request_id] = shared
+                self._pending_budget[r.request_id] = budget
                 return True
 
-        reqs = self.scheduler.admit(self.pool.free_count, t, fits=fits)
+        if self.scheduler.parked_depth:
+            # unresumed parked requests hold fresh admission entirely
+            reqs = []
+            if self.scheduler.depth:
+                self.scheduler.record_stall("parked_queue_ahead")
+        else:
+            reqs = self.scheduler.admit(self.pool.free_count, t, fits=fits)
+            if stall_override[0] is not None:
+                # rewrite the generic no_free_blocks stall the scheduler
+                # recorded into the policy-aware label (single-engine
+                # reserve-mode text stays exactly as it always was)
+                key = stall_override[0]
+                label = self.scheduler.label
+                generic = ("no_free_blocks" if label is None
+                           else f"{label}: no_free_blocks")
+                named = key if label is None else f"{label}: {key}"
+                if self.scheduler.stalls.get(generic):
+                    self.scheduler.stalls[generic] -= 1
+                    if not self.scheduler.stalls[generic]:
+                        del self.scheduler.stalls[generic]
+                    self.scheduler.stalls[named] = \
+                        self.scheduler.stalls.get(named, 0) + 1
         block = self._pick_block()
         if self.overlap_prefill:
             # OVERLAPPED admission: BOTH programs are enqueued before any
@@ -1116,6 +1306,17 @@ class Engine:
             if self.scheduler.depth > 0 and self.pool.free_count == 0:
                 self.scheduler.record_stall("no_free_slots")
             active_now = self._active.copy()
+            if self.paged:
+                self._page_table_fault(t)
+                # freshly admitted slots are PROTECTED from preemption for
+                # this tick: their first token is still in flight (read
+                # back only in _admit_finish), so parking them would lose
+                # it and break the resume arithmetic
+                protect = frozenset(int(s) for s in astate[1]) \
+                    if astate is not None else frozenset()
+                adv = (self.speculate_k + 1) if self.speculate_k else block
+                active_now = self._ensure_blocks(active_now, adv, preempted,
+                                                 protect=protect)
             dspan = None
             if active_now.any() and tr.enabled:
                 decode_args = dict(block=block, active=int(active_now.sum()))
@@ -1167,6 +1368,10 @@ class Engine:
             faults.fire(faults.MID_DECODE_TICK, t)
 
             active_now = self._active.copy()
+            if self.paged:
+                self._page_table_fault(t)
+                adv = (self.speculate_k + 1) if self.speculate_k else block
+                active_now = self._ensure_blocks(active_now, adv, preempted)
             if active_now.any():
                 if tr.enabled:
                     decode_args = dict(block=block,
@@ -1208,10 +1413,18 @@ class Engine:
                 kv_bytes_in_use=(self.pool.active_count * self.max_len
                                  * self._token_bytes),
             )
+        if self.admission_policy is not None:
+            # the admission plane's per-tick feed: parked backlog and this
+            # tick's preemption count (the sentinel's storm window eats the
+            # windowed rate) — absent for plain engines so their metric
+            # streams stay byte-identical to before
+            gauges.update(parked=self.scheduler.parked_depth,
+                          preemptions=len(preempted))
         self.metrics.record_tick(self.scheduler.depth, self.pool.active_count,
                                  self.pool.num_slots, **gauges)
         self._tick = t + 1
-        return StepEvents(emitted, finished, admitted, t)
+        return StepEvents(emitted, finished, admitted, t,
+                          preempted=preempted, resumed=resumed)
 
     def _decode_dispatch(self, active_now, block: int):
         """Enqueue this tick's decode program — the plain block-scan or the
@@ -1221,16 +1434,9 @@ class Engine:
         any readback. Returns the state :meth:`_decode_finish` reads back."""
         if self.speculate_k:
             if self.paged:
-                # worst case this cycle accepts all k drafts + the bonus
-                # token; grow page tables to that end position (clamped at
-                # the write limit, so the reservation always covers it)
-                adv = self.speculate_k + 1
-                for slot in np.nonzero(active_now)[0]:
-                    self.pool.alloc_to(
-                        int(slot),
-                        min(self._slot_len[slot] + adv,
-                            self._slot_limit[slot]),
-                    )
+                # page tables already grown to this cycle's worst-case end
+                # position by _ensure_blocks (which is also where a policy
+                # engine preempts on PoolPressure)
                 out = self._spec_tick_fn(
                     self.params, self.draft_params, self.pool.k, self.pool.v,
                     self.pool.lengths, self._draft_k, self._draft_v,
@@ -1258,15 +1464,8 @@ class Engine:
             jnp.asarray(active_now),
         )
         if self.paged:
-            # grow page tables BEFORE the dispatch to this tick's
-            # worst-case end position (never past the write limit, so
-            # the admission-time reservation always covers it)
-            for slot in np.nonzero(active_now)[0]:
-                self.pool.alloc_to(
-                    int(slot),
-                    min(self._slot_len[slot] + block,
-                        self._slot_limit[slot]),
-                )
+            # page tables were grown to this tick's worst-case end by
+            # _ensure_blocks before the dispatch decision
             out = self._tick_fns[block](
                 *args, self.pool.page_table_device(), self._limit
             )
@@ -1326,6 +1525,428 @@ class Engine:
                 self._emit(int(slot), req, int(toks_host[d, slot]),
                            emitted, finished, first=False)
 
+    # -- preempt -> park -> re-admit ---------------------------------------
+
+    def _page_table_fault(self, t: int) -> None:
+        """Chaos hook: the ``pool_page_table`` fault point's ``corrupt``
+        kind pokes an out-of-range block id into the first claimed slot's
+        page-table row. The pool's upload-time bounds check turns it into
+        a structured engine fault on this very tick — recover/requeue
+        heals it (releases reset the row), with token parity via replay."""
+        kind = faults.fire(faults.POOL_PAGE_TABLE, t)
+        if kind == faults.KIND_CORRUPT:
+            for slot, req in enumerate(self._slot_req):
+                if req is not None:
+                    self.pool.page_table[slot, 0] = self.pool.num_blocks + 7
+                    self.pool._table_device = None
+                    break
+
+    def _ensure_blocks(self, active_now, advance: int, preempted: List[int],
+                       protect=frozenset()):
+        """Grow every active slot's page table to this tick's worst-case
+        end position (``advance`` more tokens, clamped at the write
+        limit). Under the worst-case reservation gate supply is
+        guaranteed; under an admission policy the pool may come up dry
+        (:class:`PoolPressure`) — preempt the cheapest victim (never the
+        pressured slot itself, never a ``protect``-ed slot mid-prefill)
+        and retry. With no eligible victim the pressured slot simply sits
+        this tick out: nothing about it moves, so it retries next tick
+        once parked or retiring traffic frees blocks. Returns the
+        (possibly narrowed) active mask."""
+        tr = self.tracer
+        for slot in list(np.nonzero(active_now)[0]):
+            slot = int(slot)
+            if not active_now[slot]:
+                continue  # taken as a victim earlier in this very loop
+            while True:
+                try:
+                    self.pool.alloc_to(
+                        slot,
+                        min(self._slot_len[slot] + advance,
+                            self._slot_limit[slot]),
+                    )
+                    break
+                except PoolPressure as pressure:
+                    # candidates are RESIDENT slots (request still in a
+                    # slot), not the tick-narrowed mask: a slot that
+                    # already sat this tick out still holds blocks and
+                    # must stay preemptable, or two pressured slots could
+                    # deadlock each other forever
+                    candidates = [
+                        s for s, r in enumerate(self._slot_req)
+                        if r is not None and self._active[s]
+                        and s != slot and s not in protect
+                    ]
+                    victim = admission_lib.pick_victim(
+                        self.pool, candidates, self.prefix_cache)
+                    if victim is None:
+                        # no eviction frees a block: the slot skips this
+                        # tick's decode and retries next tick
+                        active_now[slot] = False
+                        if tr.enabled:
+                            tr.event("serve/decode_skip", cat="serving",
+                                     tick=self._tick, slot=slot,
+                                     need=pressure.need_blocks,
+                                     **self._obs_args)
+                        break
+                    self._preempt(victim, preempted)
+                    active_now[victim] = False
+        return active_now
+
+    def _gather_tail(self, blocks: List[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """Device→host gather of whole blocks (swap-out staging), padded
+        to a power-of-two id count so the jitted gather program set stays
+        bounded by buckets, never traffic."""
+        n = len(blocks)
+        ids = np.zeros((_block_bucket(n),), np.int32)
+        ids[:n] = blocks
+        kb, vb = gather_blocks(self.pool.k, self.pool.v, ids)
+        return (np.asarray(jax.device_get(kb))[:, :n],
+                np.asarray(jax.device_get(vb))[:, :n])
+
+    def _host_set(self, arr, index, value, sharding):
+        """Update one row of a small per-slot device array via a host
+        round trip — rare-path (preempt/resume) mutation that stays
+        correct under a serving mesh (the result is re-committed to the
+        array's replicated/sharded placement)."""
+        host = np.asarray(jax.device_get(arr)).copy()
+        host[index] = value
+        out = jnp.asarray(host)
+        if sharding is not None:
+            out = jax.device_put(out, sharding)
+        return out
+
+    def _preempt(self, slot: int, preempted: List[int]) -> None:
+        """Evict the request in ``slot``: snapshot its resume point
+        host-side, stage its live PRIVATE blocks to the host store (swap
+        mode — shared prefix blocks are decref'd, never copied: their
+        other users keep them alive and the resume re-adopts them),
+        release the slot + blocks + reservation, and park the request
+        ahead of all fresh admissions. Resumption is token-for-token
+        identical either way: swap-in restores the exact K/V bytes,
+        re-prefill recomputes them from prompt + generated-so-far."""
+        req = self._slot_req[slot]
+        rid = req.request_id
+        pool = self.pool
+        tr = self.tracer
+        generated = len(self.results[rid])
+        cur = int(np.asarray(jax.device_get(self._cur_tok))[slot])
+        gen = int(np.asarray(jax.device_get(self._gen))[slot])
+        key = np.array(np.asarray(jax.device_get(self._rngs))[slot])
+        length = int(self._slot_len[slot])
+        limit = int(self._slot_limit[slot]) if self.paged else \
+            req.prompt.size + req.max_new_tokens
+        swapped = False
+        page_start = 0
+        bytes_out = 0
+        if self._swap_store is not None:
+            arrays = None
+            if self.paged:
+                blocks = pool.blocks_of(slot)
+                live = min(pool.blocks_for(length), len(blocks))
+                for b in blocks[:live]:
+                    if pool.refcount(b) == 1 and pool.owner_of(b) == slot:
+                        break
+                    page_start += 1
+                tail = blocks[page_start:live]
+                # sharing is prefix-shaped, so the tail should be all
+                # private; anything else falls back to re-prefill rather
+                # than copying blocks out from under their other users
+                if tail and all(pool.refcount(b) == 1
+                                and pool.owner_of(b) == slot for b in tail):
+                    kb, vb = self._gather_tail(tail)
+                    arrays = {"k": kb, "v": vb}
+            else:
+                arrays = {
+                    "k": np.asarray(jax.device_get(self.pool.k[:, slot])),
+                    "v": np.asarray(jax.device_get(self.pool.v[:, slot])),
+                }
+            if arrays is not None:
+                if self.speculate_k:
+                    # the victim is mid-speculation: park its draft cache
+                    # rows too, or the resumed request's next draft cycle
+                    # would propose from a stranger's K/V
+                    arrays["draft_k"] = np.asarray(
+                        jax.device_get(self._draft_k[:, slot]))
+                    arrays["draft_v"] = np.asarray(
+                        jax.device_get(self._draft_v[:, slot]))
+                try:
+                    rec = self._swap_store.put(rid, arrays, page_start,
+                                               length)
+                    swapped = True
+                    bytes_out = rec.nbytes
+                except OSError:
+                    # injected/real swap-IO failure: the request resumes
+                    # by re-prefill instead — swap is an optimization,
+                    # never a correctness dependency
+                    self._swap_store.discard(rid)
+                    self.metrics.record_swap_fallback()
+        self._parked_state[rid] = _ParkedState(
+            request=req, generated=generated, cur_tok=cur, gen_count=gen,
+            rng_key=key, length=length, limit=limit, swapped=swapped,
+            page_start=page_start if swapped else 0,
+        )
+        self._active[slot] = False
+        self._slot_req[slot] = None
+        pool.release(slot)
+        self._slot_len[slot] = 0
+        self._slot_limit[slot] = 0
+        self.scheduler.park(req)
+        self.status[rid] = "preempted"
+        preempted.append(rid)
+        if self.admission_policy is not None:
+            self.admission_policy.note_preemption(self._tick)
+        self.metrics.record_preemption(swapped=swapped, bytes_out=bytes_out)
+        if tr.enabled:
+            tr.event("req/preempt", cat="request", rid=rid,
+                     swapped=swapped, generated=generated,
+                     swap_bytes=bytes_out, **self._obs_args)
+
+    def preempt(self, request_id: int) -> bool:
+        """Forcibly preempt a RUNNING request (park it for re-admission).
+
+        The same lifecycle pool pressure triggers, exposed for operators
+        and tests: the request's slot (and on the paged pool its private
+        blocks + reservation) come back immediately, the request parks
+        ahead of fresh admissions, and its eventual output is
+        token-for-token what an uninterrupted run produces. False for
+        ids not currently running. NOT thread-safe (like every Engine
+        method): with a ServingServer attached, stop the loop or call
+        under the engine lock."""
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.request_id == request_id \
+                    and self._active[slot]:
+                self._preempt(slot, [])
+                return True
+        return False
+
+    def _try_resume(self) -> List[int]:
+        """Re-admit parked requests, oldest first, as far as resources
+        allow (strict FIFO: the head blocks those behind it, exactly like
+        the fresh-admission queue). Returns the rids resumed."""
+        resumed: List[int] = []
+        while self.scheduler.parked_depth:
+            req = self.scheduler.peek_parked()
+            pk = self._parked_state.get(req.request_id)
+            if pk is None:
+                # resume state lost (a fault mid-resume already handed the
+                # request back through recover) — drop the stale entry
+                self.scheduler.pop_parked()
+                continue
+            if self.pool.free_count == 0 or not self._resume_one(req, pk):
+                break
+            resumed.append(req.request_id)
+        return resumed
+
+    def _resume_one(self, req: Request, pk: _ParkedState) -> bool:
+        """Attempt one parked request's re-admission. Returns False (and
+        changes nothing) when resources are short; raises only on a real
+        engine fault (the dispatch path), in which case the request has
+        already left the parked queue and recover() hands it back like
+        any running request."""
+        rid = req.request_id
+        pool = self.pool
+        tr = self.tracer
+        rec = None
+        shared: List[int] = []
+        if self.paged:
+            # swap restore needs the shared head alive: the prefix cache
+            # must still map the request's leading prompt chunks onto live
+            # blocks (their other sharers kept them); anything short of
+            # that discards the swap and re-prefills
+            swap_ok = pk.swapped and self._swap_store is not None
+            if swap_ok and pk.page_start:
+                if self.prefix_cache is None:
+                    swap_ok = False
+                else:
+                    shared = self.prefix_cache.match(req.prompt)
+                    swap_ok = len(shared) >= pk.page_start
+            adopt = shared[:pk.page_start] if swap_ok else []
+
+            def gate(n_adopt):
+                """Anti-thrash reservation check: the FULL remaining worst
+                case when it fits (a resumed request never re-enters the
+                victim pool mid-stream), else just enough to keep
+                decoding (policy engines only). Returns the tokens to
+                reserve, or None when the resume cannot go yet."""
+                tokens = pk.limit
+                if pool.blocks_for(tokens) - n_adopt > \
+                        pool.unreserved_blocks:
+                    if not pool.allow_overcommit:
+                        return None
+                    tokens = min(pk.limit, pk.length + self.page_size)
+                    if pool.blocks_for(tokens) - n_adopt > \
+                            pool.unreserved_blocks:
+                        return None
+                if pool.blocks_for(pk.length) - n_adopt > pool.free_blocks:
+                    return None
+                return tokens
+
+            reserve_tokens = gate(len(adopt))
+            if reserve_tokens is None:
+                return False
+            if swap_ok:
+                # fetch + sha-verify ONLY once the resume is committing:
+                # a parked head blocked on resources must not re-hash its
+                # whole swapped K/V every tick it stays blocked
+                try:
+                    rec = self._swap_store.get(rid)
+                except (OSError, SwapError, KeyError):
+                    self._swap_store.discard(rid)
+                    self.metrics.record_swap_fallback()
+                    pk.swapped = False  # later attempts gate as reprefill
+                    rec = None
+                if rec is None and adopt:
+                    # the gate assumed adoption; re-prefill adopts nothing
+                    adopt = []
+                    reserve_tokens = gate(0)
+                    if reserve_tokens is None:
+                        return False
+        elif pk.swapped and self._swap_store is not None:
+            try:
+                rec = self._swap_store.get(rid)
+            except (OSError, SwapError, KeyError):
+                self._swap_store.discard(rid)
+                self.metrics.record_swap_fallback()
+                rec = None
+        # resources committed: the request leaves the parked queue NOW —
+        # a dispatch fault from here on is recovered like any running
+        # request (never double-tracked as parked)
+        self.scheduler.pop_parked()
+        self._parked_state.pop(rid, None)
+        if rec is not None:
+            if self.paged:
+                self._resume_swap_in(req, pk, rec, adopt, reserve_tokens)
+            else:
+                self._resume_fixed_swap_in(req, pk, rec)
+            kind = "swap_in"
+        else:
+            self._resume_reprefill(
+                req, pk, reserve_tokens if self.paged else None)
+            kind = "reprefill"
+        if self._swap_store is not None:
+            self._swap_store.discard(rid)  # consumed (or superseded)
+        self.status[rid] = "running"
+        self.metrics.record_resume(kind,
+                                   bytes_in=rec.nbytes if rec else 0)
+        if tr.enabled:
+            tr.event("req/resume", cat="request", rid=rid, kind=kind,
+                     generated=pk.generated, **self._obs_args)
+        return True
+
+    def _resume_swap_in(self, req: Request, pk: _ParkedState,
+                        rec, adopt: List[int], reserve_tokens: int) -> None:
+        """Restore a parked request from the host block store: adopt the
+        still-live shared head, allocate fresh private blocks, scatter
+        the sha-verified host bytes back, and reinstate the slot's device
+        state — the stream resumes bitwise where it stopped."""
+        pool = self.pool
+        rid = req.request_id
+        slot = pool.claim()
+        self._slot_req[slot] = req
+        pool.reserve(slot, reserve_tokens, shared_blocks=len(adopt))
+        if adopt:
+            pool.adopt_shared(slot, adopt)
+        pool.alloc_to(slot, pk.length)
+        n_pages = pool.blocks_for(pk.length)
+        dst = [int(b) for b in pool.page_table[slot, pk.page_start:n_pages]]
+        kb, vb = rec.arrays["k"], rec.arrays["v"]
+        assert len(dst) == kb.shape[1], "swap record / page-table mismatch"
+        bucket = _block_bucket(len(dst))
+        ids = np.full((bucket,), pool.num_blocks, np.int32)  # dropped pads
+        ids[:len(dst)] = dst
+        pad = [(0, 0)] * kb.ndim
+        pad[1] = (0, bucket - kb.shape[1])
+        new_k, new_v = scatter_blocks(pool.k, pool.v, ids,
+                                      jnp.asarray(np.pad(kb, pad)),
+                                      jnp.asarray(np.pad(vb, pad)))
+        if self._kv_sharding is not None:
+            new_k = jax.device_put(new_k, self._kv_sharding)
+            new_v = jax.device_put(new_v, self._kv_sharding)
+        rep = self._rep_sharding
+        lengths = self._host_set(pool.lengths, slot, pk.length, rep)
+        pool.set_arrays(new_k, new_v, lengths)
+        self._restore_slot_state(slot, pk, rec)
+        self._slot_len[slot] = pk.length
+        self._slot_limit[slot] = pk.limit
+        self._active[slot] = True
+
+    def _resume_fixed_swap_in(self, req: Request, pk: _ParkedState,
+                              rec) -> None:
+        """Fixed-pool restore: the swap unit is the whole slot row."""
+        pool = self.pool
+        slot = pool.claim()
+        self._slot_req[slot] = req
+        k = self._host_set(pool.k, (slice(None), slot), rec.arrays["k"],
+                           self._kv_sharding)
+        v = self._host_set(pool.v, (slice(None), slot), rec.arrays["v"],
+                           self._kv_sharding)
+        lengths = self._host_set(pool.lengths, slot, pk.length,
+                                 self._rep_sharding)
+        pool.set_arrays(k, v, lengths)
+        self._restore_slot_state(slot, pk, rec)
+        self._slot_len[slot] = pk.length
+        self._active[slot] = True
+
+    def _restore_slot_state(self, slot: int, pk: _ParkedState, rec) -> None:
+        rep = self._rep_sharding
+        self._cur_tok = self._host_set(self._cur_tok, slot, pk.cur_tok, rep)
+        self._gen = self._host_set(self._gen, slot, pk.gen_count, rep)
+        self._rngs = self._host_set(self._rngs, slot, pk.rng_key, rep)
+        if self.paged:
+            self._limit = self._host_set(self._limit, slot, pk.limit, rep)
+        if self.speculate_k and rec is not None \
+                and "draft_k" in rec.arrays:
+            self._draft_k = self._host_set(
+                self._draft_k, (slice(None), slot), rec.arrays["draft_k"],
+                self._dkv_sharding)
+            self._draft_v = self._host_set(
+                self._draft_v, (slice(None), slot), rec.arrays["draft_v"],
+                self._dkv_sharding)
+
+    def _resume_reprefill(self, req: Request, pk: _ParkedState,
+                          reserve_tokens: Optional[int] = None) -> None:
+        """Recompute a parked request's K/V instead of restoring bytes:
+        re-prefill ``prompt + generated[:-1]`` through the NORMAL admit
+        program (same compile buckets), then pin the resume point — the
+        admit-sampled first token is discarded (never emitted) and the
+        generation counter restored, so the continued stream folds the
+        SAME rng indices an uninterrupted run would have.
+        ``reserve_tokens`` is the reservation _resume_one validated — it
+        may be LESS than the full worst case under pressure, and the
+        dispatch must reserve exactly what was checked, not re-derive."""
+        rid = req.request_id
+        if reserve_tokens is not None:
+            # consumed by _admit_dispatch's reserve call, like any
+            # policy-budgeted admission
+            self._pending_budget[rid] = int(reserve_tokens)
+        g = pk.generated
+        prior = np.asarray(self.results[rid][:g - 1], np.int32)
+        ext = np.concatenate([np.asarray(req.prompt, np.int32), prior])
+        assert ext.size == pk.length, "resume point drifted from the mirror"
+        synth = Request(
+            request_id=rid, prompt=ext,
+            max_new_tokens=pk.limit - int(ext.size),
+            eos_id=req.eos_id, rng_seed=req.rng_seed,
+            deadline_tick=req.deadline_tick, submit_tick=req.submit_tick,
+        )
+        self._resuming_rid = rid
+        try:
+            state = self._admit_dispatch([synth])
+        finally:
+            self._resuming_rid = None
+            # whatever happened, the slot map must point at the ORIGINAL
+            # request: retirement compares against its max_new_tokens, and
+            # a fault's recover() must hand back the real thing
+            for s, r in enumerate(self._slot_req):
+                if r is synth:
+                    self._slot_req[s] = req
+        slot = int(state[1][0])
+        rep = self._rep_sharding
+        self._cur_tok = self._host_set(self._cur_tok, slot, pk.cur_tok, rep)
+        self._gen = self._host_set(self._gen, slot, pk.gen_count, rep)
+        self._active[slot] = True
+
     def pop_result(self, request_id: int) -> Tuple[List[int], str]:
         """Remove and return ``(tokens, status)`` for a finished (or
         expired) request. The streaming/driver front-ends call this on
@@ -1350,6 +1971,12 @@ class Engine:
         holds the engine lock."""
         tr = self.tracer
         if self.scheduler.cancel(request_id):
+            # a PARKED request cancels like a queued one, plus its resume
+            # state: the host swap record and the park snapshot both go
+            # (the partial result stays poppable, same as a running cancel)
+            self._parked_state.pop(request_id, None)
+            if self._swap_store is not None:
+                self._swap_store.discard(request_id)
             self.status[request_id] = "cancelled"
             self.metrics.record_finish(request_id, "cancelled")
             ts0 = self._req_submit_ts.pop(request_id, None)
@@ -1391,10 +2018,16 @@ class Engine:
         failed = []
         tr = self.tracer
         self._pending_match.clear()
+        self._pending_budget.clear()
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
             failed.append(req)
+            # a fault mid-RESUME: the request is back in the failed set,
+            # so any leftover park bookkeeping must not shadow the requeue
+            self._parked_state.pop(req.request_id, None)
+            if self._swap_store is not None:
+                self._swap_store.discard(req.request_id)
             self._slot_req[slot] = None
             self._active[slot] = False
             self.pool.release(slot)
@@ -1546,7 +2179,14 @@ class Engine:
             for i, (slot, r) in enumerate(zip(slots, reqs)):
                 shared = matches.get(r.request_id, [])
                 budget = r.prompt.size + r.max_new_tokens
-                self.pool.reserve(slot, budget, shared_blocks=len(shared))
+                # the RESERVATION is the admission policy's budget (the
+                # quantile/optimistic ask the gate admitted on); the write
+                # limit below stays the full worst case — optimism bounds
+                # admission, never what a request may write
+                self.pool.reserve(slot,
+                                  self._pending_budget.pop(r.request_id,
+                                                           budget),
+                                  shared_blocks=len(shared))
                 if shared:
                     self.pool.adopt_shared(slot, shared)
                 self.pool.alloc_to(slot, r.prompt.size)
@@ -1638,8 +2278,12 @@ class Engine:
         for i, r in enumerate(reqs):
             skipped = shared_tok.get(r.request_id, 0)
             # hit-rate denominator: only admissions that COULD have hit —
-            # a sub-page prompt has no full chunk to match by construction
-            eligible = prefix and r.prompt.size > self.page_size
+            # a sub-page prompt has no full chunk to match by construction,
+            # and a re-prefill RESUME row never consults the index (its
+            # recomputed tokens are billed, but it must not count as a
+            # second miss against the hit rate)
+            eligible = (prefix and r.prompt.size > self.page_size
+                        and r.request_id != self._resuming_rid)
             n_shared = len(matches.get(r.request_id, ()))
             self.metrics.record_admission(
                 computed_tokens=tails[i], skipped_tokens=skipped,
@@ -1668,6 +2312,12 @@ class Engine:
         reqs, slots, tok0 = state
         tok0_host = np.asarray(jax.device_get(tok0))
         for slot, req, tok in zip(slots, reqs, tok0_host):
+            if self._slot_req[slot] is not req:
+                # the slot changed hands between dispatch and readback
+                # (mid-tick preemption is excluded by the protect set, so
+                # this is pure defense) — emitting would corrupt a
+                # stranger's stream
+                continue
             if activate:
                 self._active[slot] = True
                 self.status[req.request_id] = "running"
@@ -1693,6 +2343,10 @@ class Engine:
             self.status[rid] = "done"
             finished.append((rid, reason))
             self.metrics.record_finish(rid, reason)
+            if self.admission_policy is not None:
+                # a real completion is the quantile estimator's food: how
+                # many tokens this request ACTUALLY generated
+                self.admission_policy.observe_finish(len(out))
             tr = self.tracer
             ts0 = self._req_admit_ts.pop(rid, None)
             if tr.enabled and ts0 is not None:

@@ -79,6 +79,7 @@ class ServingMetrics:
         self.shared_blocks_peak: Optional[int] = None
         self._submit_t: Dict[int, float] = {}
         self._last_token_t: Dict[int, float] = {}
+        self._admitted: set = set()  # rids whose queue wait is recorded
         self.tokens_emitted = 0
         self.ticks = 0
         self.finished: Dict[str, int] = {}  # reason -> count
@@ -116,6 +117,26 @@ class ServingMetrics:
         self._g_spec_accept = reg.gauge("serving/spec_accept_rate",
                                         labels=self._labels)
         self._spec_window = LatencySeries(window=64)  # per-tick accept frac
+        # admission-control plane: preempt -> park -> resume accounting
+        # (cumulative host ints + registry counters; the per-tick windowed
+        # preemption rate is the sentinel's preemption_storm feed)
+        self.preemptions = 0
+        self.swap_outs = 0
+        self.swap_ins = 0
+        self.reprefills = 0
+        self.swap_fallbacks = 0  # swap dropped (IO error / sha / dead head)
+        self.swap_bytes_out = 0
+        self.swap_bytes_in = 0
+        self.parked_peak = 0
+        self._c_preempt = reg.counter("serving/preemptions_total",
+                                      labels=self._labels)
+        self._c_swap_out_bytes = reg.counter("serving/swap_bytes_out_total",
+                                             labels=self._labels)
+        self._c_swap_in_bytes = reg.counter("serving/swap_bytes_in_total",
+                                            labels=self._labels)
+        self._c_reprefill = reg.counter("serving/resume_reprefills_total",
+                                        labels=self._labels)
+        self._preempt_window = LatencySeries(window=64)  # preempts/tick
 
     # -- per-request lifecycle -------------------------------------------
 
@@ -135,8 +156,15 @@ class ServingMetrics:
         queue-wait SLO reads. The engine calls this at the admission POP
         itself — whatever ``Scheduler(prefill_interval)`` phase or
         prefill-overlap mode the tick runs under — so every admitted
-        request contributes its full wait exactly once."""
+        request contributes its full wait exactly once. "Once" is
+        enforced HERE: a preempted request re-admits through the same
+        dispatch path (and a parked expiry reports through
+        record_expired), and neither may add a second, submit-to-resume
+        sized sample to the series the queue-wait SLO reads."""
+        if request_id in self._admitted:
+            return
         if request_id in self._submit_t:
+            self._admitted.add(request_id)
             self.queue_wait.add(self.clock() - self._submit_t[request_id])
 
     def record_expired(self, request_id: int) -> None:
@@ -176,6 +204,40 @@ class ServingMetrics:
         stale mid-run; the cumulative rate would hide it)."""
         return self._spec_window.summary()["mean"]
 
+    def record_preemption(self, swapped: bool, bytes_out: int = 0) -> None:
+        """One victim evicted: slot + private blocks reclaimed, request
+        parked. ``swapped`` says its K/V went to the host store (vs the
+        drop-and-re-prefill path)."""
+        self.preemptions += 1
+        self._c_preempt.inc()
+        if swapped:
+            self.swap_outs += 1
+            self.swap_bytes_out += int(bytes_out)
+            self._c_swap_out_bytes.inc(int(bytes_out))
+
+    def record_resume(self, kind: str, bytes_in: int = 0) -> None:
+        """A parked request re-entered a slot: ``kind`` is "swap_in"
+        (host bytes scattered back) or "reprefill" (recomputed)."""
+        if kind == "swap_in":
+            self.swap_ins += 1
+            self.swap_bytes_in += int(bytes_in)
+            self._c_swap_in_bytes.inc(int(bytes_in))
+        else:
+            self.reprefills += 1
+            self._c_reprefill.inc()
+
+    def record_swap_fallback(self) -> None:
+        """A swap record was abandoned (IO error, sha mismatch, or its
+        shared head died) — the request resumes by re-prefill instead.
+        Swap is an optimization; this counter is its failure bill."""
+        self.swap_fallbacks += 1
+
+    def recent_preemption_rate(self) -> Optional[float]:
+        """Mean preemptions/tick over the last 64 ticks — the sentinel's
+        ``preemption_storm`` feed (None before any admission-policy
+        tick)."""
+        return self._preempt_window.summary()["mean"]
+
     def record_token(self, request_id: int, first: bool) -> None:
         now = self.clock()
         if first and request_id in self._submit_t:
@@ -192,6 +254,7 @@ class ServingMetrics:
                               labels=self._labels).inc()
         self._submit_t.pop(request_id, None)
         self._last_token_t.pop(request_id, None)
+        self._admitted.discard(request_id)
 
     def record_admission(self, computed_tokens: int, skipped_tokens: int = 0,
                          shared_blocks: int = 0,
@@ -221,7 +284,9 @@ class ServingMetrics:
                     kv_bytes_in_use: Optional[int] = None,
                     free_blocks: Optional[int] = None,
                     decode_block: Optional[int] = None,
-                    shared_blocks: Optional[int] = None) -> None:
+                    shared_blocks: Optional[int] = None,
+                    parked: Optional[int] = None,
+                    preemptions: Optional[int] = None) -> None:
         self.ticks += 1
         self.queue_depth.add(queue_depth)
         self.occupancy.add(active_slots / num_slots)
@@ -258,6 +323,14 @@ class ServingMetrics:
                     or shared_blocks > self.shared_blocks_peak):
                 self.shared_blocks_peak = shared_blocks
             scalars["serving/shared_kv_blocks"] = float(shared_blocks)
+        if parked is not None:
+            if parked > self.parked_peak:
+                self.parked_peak = parked
+            scalars["serving/parked_requests"] = float(parked)
+        if preemptions is not None:
+            # zero ticks count too: the windowed RATE must decay once a
+            # storm passes, or the sentinel could never resolve it
+            self._preempt_window.add(preemptions)
         # one call: records every scalar as a registry gauge AND streams to
         # the EventWriter when one is attached (replica-labeled in a fleet)
         self.registry.publish(scalars, step=self.ticks, labels=self._labels)
@@ -305,6 +378,14 @@ class ServingMetrics:
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
             "spec_accept_rate": self.spec_accept_rate(),
+            "preemptions": self.preemptions,
+            "swap_outs": self.swap_outs,
+            "swap_ins": self.swap_ins,
+            "reprefills": self.reprefills,
+            "swap_fallbacks": self.swap_fallbacks,
+            "swap_bytes_out": self.swap_bytes_out,
+            "swap_bytes_in": self.swap_bytes_in,
+            "parked_peak": self.parked_peak,
             "tokens_emitted": self.tokens_emitted,
             "tokens_per_second": self.tokens_per_second(),
             "ticks": self.ticks,

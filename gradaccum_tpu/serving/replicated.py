@@ -142,6 +142,13 @@ class _FleetMetrics:
             "spec_proposed": proposed,
             "spec_accepted": accepted,
             "spec_accept_rate": (accepted / proposed) if proposed else None,
+            # admission plane: preemption is PER-REPLICA (each engine
+            # evicts and re-admits within its own pool), so the fleet
+            # numbers are plain sums — requeue-after-fault stays the
+            # server's fleet-wide concern, unchanged
+            "preemptions": sum(p["preemptions"] for p in per),
+            "swap_bytes_out": sum(p["swap_bytes_out"] for p in per),
+            "swap_bytes_in": sum(p["swap_bytes_in"] for p in per),
             "per_replica": per,
         }
 
@@ -280,6 +287,13 @@ class ReplicatedEngine:
     @property
     def queue_depth(self) -> int:
         return sum(e.scheduler.depth for e in self.replicas)
+
+    @property
+    def parked_depth(self) -> int:
+        """Fleet-wide preemption backlog (each replica parks and resumes
+        within its own pool — parked requests never migrate replicas,
+        their K/V or swap record lives with the pool that owns it)."""
+        return sum(e.scheduler.parked_depth for e in self.replicas)
 
     def decode_compile_count(self) -> int:
         """Fleet total. The per-replica bound is the invariant — each

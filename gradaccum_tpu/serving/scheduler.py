@@ -81,8 +81,19 @@ class Scheduler:
         self.max_prefill_per_tick = max_prefill_per_tick
         self.prefill_interval = prefill_interval
         self._queue: Deque[Request] = deque()
+        # preempted requests waiting to RE-enter a slot. Strictly ahead of
+        # new admissions (the engine resumes parked heads before admitting
+        # fresh traffic, and holds fresh admission while any are parked):
+        # they already consumed prefill + decode work, and admitting around
+        # them is exactly the thrash an admission policy must not feed.
+        # Does not count against max_queue — parking is the ENGINE shedding
+        # load onto the host, not a caller submitting more.
+        self._parked: Deque[Request] = deque()
         # why admission stalled, per tick it stalled: "no_free_slots" vs
-        # "no_free_blocks" tells an operator which resource to grow. A
+        # "no_free_blocks" tells an operator which resource to grow;
+        # admission-policy engines add "held_by_quantile_gate" (blocks
+        # exist but the policy's budget gate refused) and
+        # "parked_queue_ahead" (preempted requests resume first). A
         # replica engine sets ``label`` ("replica 2") so fleet-level stall
         # keys also say WHICH engine is saturated; None keeps the
         # single-engine keys exactly as they always were.
@@ -116,9 +127,27 @@ class Scheduler:
     def depth(self) -> int:
         return len(self._queue)
 
+    @property
+    def parked_depth(self) -> int:
+        return len(self._parked)
+
     def peek(self) -> Optional[Request]:
         """The request next in line for admission (None when empty)."""
         return self._queue[0] if self._queue else None
+
+    # -- the parked (preemption) queue ------------------------------------
+
+    def park(self, request: Request) -> None:
+        """Queue a PREEMPTED request for re-admission, FIFO among parked
+        (the earliest victim resumes first) and ahead of every fresh
+        admission."""
+        self._parked.append(request)
+
+    def peek_parked(self) -> Optional[Request]:
+        return self._parked[0] if self._parked else None
+
+    def pop_parked(self) -> Request:
+        return self._parked.popleft()
 
     def submit(self, request: Request) -> None:
         if len(self._queue) >= self.max_queue:
@@ -129,24 +158,33 @@ class Scheduler:
         self._queue.append(request)
 
     def cancel(self, request_id: int) -> bool:
-        """Remove a QUEUED request (running ones finish on their own; slots
-        are cheap, mid-flight surgery is not). False when not queued — so a
-        later ``expire`` can never double-report a cancelled request."""
-        for r in self._queue:
-            if r.request_id == request_id:
-                self._queue.remove(r)
-                return True
+        """Remove a QUEUED or PARKED request (running ones finish on their
+        own; slots are cheap, mid-flight surgery is not). False when in
+        neither queue — so a later ``expire`` can never double-report a
+        cancelled request. The engine cleans up a parked request's resume
+        state (swap record) on top of this."""
+        for q in (self._queue, self._parked):
+            for r in q:
+                if r.request_id == request_id:
+                    q.remove(r)
+                    return True
         return False
 
     def expire(self, tick: int) -> List[Request]:
-        """Drop queued requests whose deadline has passed. Returns them."""
+        """Drop queued AND parked requests whose deadline has passed.
+        Returns them. A preempted request is back to WAITING — its
+        deadline means the same thing it meant in the fresh queue, and
+        exempting it would let a governed pool hold expired work forever
+        (the engine cleans a parked expiry's resume state on top)."""
         expired = [
-            r for r in self._queue
+            r for q in (self._queue, self._parked) for r in q
             if r.deadline_tick is not None and tick > r.deadline_tick
         ]
         if expired:
             dead = set(id(r) for r in expired)
             self._queue = deque(r for r in self._queue if id(r) not in dead)
+            self._parked = deque(r for r in self._parked
+                                 if id(r) not in dead)
         return expired
 
     def admit(self, free_slots: int, tick: int,

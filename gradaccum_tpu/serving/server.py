@@ -480,6 +480,22 @@ class ServingServer:
                 "prefill_tokens_skipped":
                     engine.metrics.prefill_tokens_skipped,
             }
+        policy = getattr(engine, "admission_policy", None)
+        if policy is not None:
+            # the admission plane's live view: how optimistic the gate is
+            # running, the preemption bill, and the parked backlog — the
+            # numbers that say whether overcommit is earning its keep
+            m = engine.metrics
+            out["admission"] = {
+                **policy.status(),
+                "parked": engine.scheduler.parked_depth,
+                "swap_ins": m.swap_ins,
+                "reprefills": m.reprefills,
+                "swap_fallbacks": m.swap_fallbacks,
+                "swap_bytes_out": m.swap_bytes_out,
+                "swap_bytes_in": m.swap_bytes_in,
+                "governed": policy.governed(engine.tick_count),
+            }
         return out
 
     def stats(self) -> Dict:
@@ -851,13 +867,25 @@ class ServingServer:
                             snt.observe_accept(
                                 self._engine.metrics.recent_accept_rate(),
                                 replica=self._engine.replica_id)
+                        if getattr(self._engine, "admission_policy",
+                                   None) is not None:
+                            snt.observe_preemptions(
+                                self._engine.metrics
+                                .recent_preemption_rate(),
+                                replica=self._engine.replica_id)
                     else:
-                        # per-replica accept rates: one replica's stale
-                        # draft must not hide behind the fleet average
+                        # per-replica accept/preemption rates: one
+                        # replica's stale draft (or thrashing pool) must
+                        # not hide behind the fleet average
                         for e in self._engine.replicas:
                             if getattr(e, "speculate_k", 0):
                                 snt.observe_accept(
                                     e.metrics.recent_accept_rate(),
+                                    replica=e.replica_id)
+                            if getattr(e, "admission_policy",
+                                       None) is not None:
+                                snt.observe_preemptions(
+                                    e.metrics.recent_preemption_rate(),
                                     replica=e.replica_id)
                     snt.observe_tick(time.monotonic() - t0)
                     snt.check()
@@ -948,6 +976,9 @@ class ServingServer:
                     if getattr(eng, "speculate_k", 0):
                         snt.observe_accept(eng.metrics.recent_accept_rate(),
                                            replica=i)
+                    if getattr(eng, "admission_policy", None) is not None:
+                        snt.observe_preemptions(
+                            eng.metrics.recent_preemption_rate(), replica=i)
                     snt.check()
                 if self._slo is not None and i == 0:
                     self._slo.tick()

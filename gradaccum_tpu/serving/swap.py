@@ -1,0 +1,117 @@
+"""Host-memory block store for preempted requests (swap-out / swap-in).
+
+The paged layout makes the swap unit a BLOCK: a victim's live private
+blocks are gathered device→host in block units (`models/gpt_decode.py::
+gather_blocks`, one bucketed compile-once program), freed back to the
+pool, and either scattered back into freshly allocated blocks at
+re-admission (``scatter_blocks``) or discarded in favor of re-prefilling
+the victim's prompt + generated-so-far tokens — the same
+recomputation-vs-memory tradeoff the activation-checkpointing literature
+studies, exposed as ``Engine(swap="host"|"recompute")``.
+
+Every record carries a sha256 over its arrays and metadata, verified at
+swap-in: a bit that rots in host memory (or a fault-injected IO error —
+``resilience/faults.py::MID_SWAP_IO``) surfaces as :class:`SwapError` /
+``OSError`` and the engine falls back to re-prefill instead of silently
+decoding against corrupt K/V. Records are host numpy only — nothing here
+holds device memory, which is the whole point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, Optional
+
+import numpy as np
+
+from gradaccum_tpu.resilience import faults
+
+
+class SwapError(RuntimeError):
+    """A swap record failed its sha256 round-trip check — the host copy
+    is not the bytes that left the device, so it must not re-enter the
+    pool (the engine falls back to re-prefill)."""
+
+
+@dataclasses.dataclass
+class SwapRecord:
+    """One preempted request's host-side K/V. ``arrays`` maps names
+    ("k"/"v", plus "draft_k"/"draft_v" for speculative engines) to host
+    numpy; ``page_start`` is the first page index the block arrays cover
+    (pages before it were shared-prefix blocks, left alive in the pool
+    under their refcounts)."""
+
+    arrays: Dict[str, np.ndarray]
+    page_start: int
+    length: int
+    digest: str
+    nbytes: int
+
+    def compute_digest(self) -> str:
+        h = hashlib.sha256()
+        h.update(np.int64([self.page_start, self.length]).tobytes())
+        for name in sorted(self.arrays):
+            h.update(name.encode())
+            h.update(np.ascontiguousarray(self.arrays[name]).tobytes())
+        return h.hexdigest()
+
+
+class HostSwapStore:
+    """rid-keyed host block store with sha-checked round trips.
+
+    ``put`` and ``get`` run the :data:`~gradaccum_tpu.resilience.faults.
+    MID_SWAP_IO` fault hook (index = request id), so chaos schedules can
+    fail either direction of the swap; both directions propagate
+    ``OSError`` to the engine, whose fallback is always re-prefill —
+    swap is an optimization, never a correctness dependency.
+    """
+
+    def __init__(self):
+        self._recs: Dict[int, SwapRecord] = {}
+        self.bytes_out = 0  # cumulative device->host
+        self.bytes_in = 0   # cumulative host->device (successful gets)
+
+    def __len__(self) -> int:
+        return len(self._recs)
+
+    def __contains__(self, rid: int) -> bool:
+        return int(rid) in self._recs
+
+    @property
+    def held_bytes(self) -> int:
+        return sum(r.nbytes for r in self._recs.values())
+
+    def put(self, rid: int, arrays: Dict[str, np.ndarray], page_start: int,
+            length: int) -> SwapRecord:
+        faults.fire(faults.MID_SWAP_IO, int(rid))
+        # the store OWNS its bytes: device_get hands back read-only views,
+        # and a record must outlive whatever buffer produced it
+        arrays = {k: np.array(v, copy=True) for k, v in arrays.items()}
+        rec = SwapRecord(arrays=arrays, page_start=int(page_start),
+                         length=int(length), digest="",
+                         nbytes=sum(a.nbytes for a in arrays.values()))
+        rec.digest = rec.compute_digest()
+        self._recs[int(rid)] = rec
+        self.bytes_out += rec.nbytes
+        return rec
+
+    def get(self, rid: int) -> SwapRecord:
+        """Verified fetch (the record stays in the store until
+        :meth:`discard`); raises KeyError for unknown rids, OSError under
+        an injected swap-IO fault, :class:`SwapError` on digest
+        mismatch."""
+        rec = self._recs[int(rid)]
+        faults.fire(faults.MID_SWAP_IO, int(rid))
+        if rec.compute_digest() != rec.digest:
+            raise SwapError(
+                f"swap record for request {rid} failed its sha256 check"
+            )
+        self.bytes_in += rec.nbytes
+        return rec
+
+    def discard(self, rid: int) -> bool:
+        return self._recs.pop(int(rid), None) is not None
+
+    def clear(self) -> None:
+        self._recs.clear()

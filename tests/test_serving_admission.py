@@ -1,0 +1,711 @@
+"""Admission control plane: optimistic admission, preemption, swap.
+
+The load-bearing gates mirror the paged/prefix suites': under admission
+policies that overcommit the block pool, every request's output — greedy
+AND seeded-sampled, THROUGH at least one forced preemption, on the fixed,
+paged, and prefix-shared pools — must be token-for-token what
+``generate_cached`` produces for that prompt alone. Preemption/swap is a
+throughput mechanism; it must never be visible in results.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+pytestmark = [pytest.mark.serving, pytest.mark.admission]
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(
+        jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)}
+    )
+    return cfg, bundle, params
+
+
+def _solo(params, cfg, prompt, n, **kw):
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+
+    return np.asarray(generate_cached(params, cfg, prompt, n, **kw)
+                      )[0, prompt.size:]
+
+
+# -- the estimator + policy (host-side units) --------------------------------
+
+
+def test_quantile_estimator_warmup_and_window():
+    from gradaccum_tpu.serving import LengthQuantileEstimator
+
+    est = LengthQuantileEstimator(window=8, min_samples=4)
+    for g in (2, 3):
+        est.observe(g)
+    assert est.quantile(0.9) is None  # below the warmup floor
+    for g in (4, 5):
+        est.observe(g)
+    assert est.quantile(1.0) == 5
+    assert est.quantile(0.5) == 4  # ceil of interpolated median 3.5
+    for g in [20] * 8:  # the ring forgets the short era
+        est.observe(g)
+    assert est.quantile(0.5) == 20
+
+
+def test_policy_budgets_and_governor():
+    from gradaccum_tpu.serving import AdmissionPolicy
+
+    worst = 10 + 20
+    res = AdmissionPolicy(mode="reserve")
+    assert res.budget_tokens(10, 20, 4, tick=0) == worst
+
+    opt = AdmissionPolicy(mode="optimistic")
+    assert opt.budget_tokens(10, 20, 4, tick=0) == 14  # prompt + one page
+
+    qnt = AdmissionPolicy(mode="quantile", q=0.9, min_samples=2)
+    assert qnt.budget_tokens(10, 20, 4, tick=0) == worst  # cold start
+    for g in (4, 4, 6):
+        qnt.observe_finish(g)
+    assert qnt.budget_tokens(10, 20, 4, tick=0) < worst
+    # the quantile never promises beyond the declared worst case
+    assert qnt.budget_tokens(10, 2, 4, tick=0) == 12
+
+    # the thrash governor: a preemption burst flips budgets to worst case
+    gov = AdmissionPolicy(mode="optimistic", storm_preempts=2,
+                          storm_window=16, cooldown=10)
+    gov.note_preemption(5)
+    assert not gov.governed(5)
+    gov.note_preemption(6)
+    assert gov.governed(6)
+    assert gov.budget_tokens(10, 20, 4, tick=7) == worst
+    assert not gov.governed(16)  # cooldown elapsed
+    assert gov.budget_tokens(10, 20, 4, tick=16) == 14
+
+
+def test_pool_pressure_is_structured(tiny_lm):
+    from gradaccum_tpu.models.gpt import GPTConfig
+    from gradaccum_tpu.serving import PagedCachePool, PoolPressure
+
+    cfg = GPTConfig.tiny_for_tests()
+    pool = PagedCachePool(cfg, num_slots=2, max_len=16, page_size=4,
+                          num_blocks=3)
+    pool.allow_overcommit = True
+    a = pool.claim()
+    pool.reserve(a, 4)  # one block promised, more taken on demand
+    with pytest.raises(PoolPressure) as exc:
+        pool.alloc_to(a, 16)  # wants 4 blocks, pool holds 3
+    assert exc.value.slot == a
+    assert exc.value.need_blocks == 1
+    assert exc.value.free_blocks == 0
+    # partial growth stayed: the slot holds what the pool could supply
+    assert pool.allocated_blocks == 3
+
+
+def test_victim_policy_never_picks_shared_or_hot_blocks(tiny_lm):
+    """A slot whose blocks are shared by another slot (or live in the
+    prefix cache) is never the cheap victim; a slot with nothing
+    reclaimable is not a victim at all."""
+    from gradaccum_tpu.models.gpt import GPTConfig
+    from gradaccum_tpu.serving import PagedCachePool, PrefixCache
+    from gradaccum_tpu.serving.admission import pick_victim, victim_cost
+
+    cfg = GPTConfig.tiny_for_tests()
+    pc = PrefixCache(4)
+    pool = PagedCachePool(cfg, num_slots=3, max_len=16, page_size=4,
+                          num_blocks=8, prefix_cache=pc)
+    a = pool.claim()
+    pool.reserve(a, 8)
+    pool.alloc_to(a, 8)  # 2 private blocks
+    b = pool.claim()
+    pool.reserve(b, 8)
+    pool.alloc_to(b, 8)
+    c = pool.claim()
+    pool.reserve(c, 8, shared_blocks=2)
+    pool.adopt_shared(c, pool.blocks_of(a))  # a's blocks now shared with c
+    # a's blocks are shared -> b (all private) is the cheap victim
+    assert pick_victim(pool, [a, b], None) == b
+    assert victim_cost(pool, a, None) > victim_cost(pool, b, None)
+    # hot-in-prefix-cache costs too: index b's first block, b gets pricier
+    pc.insert(np.arange(4, dtype=np.int32), [pool.blocks_of(b)[0]])
+    assert victim_cost(pool, b, pc) > victim_cost(pool, b, None)
+    # c adopted everything it maps: nothing reclaimable -> not a victim
+    assert pick_victim(pool, [c], None) is None
+
+
+# -- parity through forced preemption: fixed, paged, prefix pools ------------
+
+
+@pytest.mark.parametrize("swap", ["host", "recompute"])
+def test_fixed_pool_forced_preemption_parity(tiny_lm, swap):
+    """The acceptance gate's fixed-pool leg: preempt a running request on
+    the FIXED pool (slot-granular swap unit), greedy + sampled parity."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    prompt = np.arange(1, 7, dtype=np.int32)
+    for kw, gen_kw in (
+        ({}, {}),
+        (dict(temperature=0.8, top_k=5),
+         dict(temperature=0.8, top_k=5, rng=jax.random.PRNGKey(3))),
+    ):
+        eng = Engine(params, cfg, num_slots=2, max_len=32, swap=swap, **kw)
+        rid = eng.submit(prompt, 10, rng_seed=3)
+        for _ in range(3):
+            eng.step()
+        assert eng.preempt(rid) is True
+        assert eng.status[rid] == "preempted"
+        assert eng.preempt(rid) is False  # not running any more
+        eng.run_until_idle()
+        np.testing.assert_array_equal(
+            np.asarray(eng.results[rid]),
+            _solo(params, cfg, prompt, 10, **gen_kw),
+        )
+        if swap == "host":
+            assert eng.metrics.swap_ins == 1
+        else:
+            assert eng.metrics.reprefills == 1
+
+
+@pytest.mark.parametrize("temperature,top_k", [(0.0, None), (0.8, 5)])
+def test_paged_optimistic_preemption_parity(tiny_lm, temperature, top_k):
+    """The tentpole gate: optimistic admission on a pool too small for
+    everyone's worst case — pressure forces at least one preemption, and
+    every stream (greedy and seeded-sampled) is token-for-token the solo
+    output."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, 6).astype(np.int32)
+               for _ in range(4)]
+    eng = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                 num_blocks=10, admission="optimistic",
+                 temperature=temperature, top_k=top_k)
+    rids = [eng.submit(p, 12, rng_seed=i) for i, p in enumerate(prompts)]
+    eng.run_until_idle()
+    assert eng.metrics.preemptions >= 1, "the pool never came under pressure"
+    for i, (p, r) in enumerate(zip(prompts, rids)):
+        kw = ({} if temperature == 0 else
+              dict(temperature=temperature, top_k=top_k,
+                   rng=jax.random.PRNGKey(i)))
+        np.testing.assert_array_equal(np.asarray(eng.results[r]),
+                                      _solo(params, cfg, p, 12, **kw))
+    # the pool drained clean: every block, reservation, and parked record
+    assert eng.pool.allocated_blocks == 0
+    assert eng.pool.unreserved_blocks == eng.pool.num_blocks
+    assert eng.scheduler.parked_depth == 0
+    assert not eng._parked_state
+
+
+def test_prefix_shared_victim_decrefs_not_frees(tiny_lm):
+    """A victim holding SHARED prefix blocks: preempting it decrefs — the
+    surviving sharer keeps decoding against live blocks — and both
+    streams (victim resumed, survivor untouched) hold greedy parity."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(1)
+    sys_prompt = rng.integers(0, cfg.vocab_size, 8).astype(np.int32)
+    p1 = np.concatenate([sys_prompt, rng.integers(0, cfg.vocab_size, 3)
+                         .astype(np.int32)])
+    p2 = np.concatenate([sys_prompt, rng.integers(0, cfg.vocab_size, 2)
+                         .astype(np.int32)])
+    eng = Engine(params, cfg, num_slots=3, max_len=32, page_size=4,
+                 num_blocks=16, prefix_cache=True, admission="quantile")
+    r1 = eng.submit(p1, 10)
+    eng.step()  # p1 prefills, its prompt chunks get indexed
+    r2 = eng.submit(p2, 10)
+    eng.step()  # p2 adopts p1's leading blocks
+    assert eng.pool.shared_blocks >= 2, "the prefix was never shared"
+    shared_ids = [b for b in eng.pool.blocks_of(0)
+                  if eng.pool.refcount(b) > 1]
+    assert eng.preempt(r1) is True
+    # decref, not free: the survivor's shared blocks are still alive
+    for b in shared_ids:
+        assert eng.pool.refcount(b) >= 1
+    assert eng.status[r2] == "running"
+    eng.run_until_idle()
+    np.testing.assert_array_equal(np.asarray(eng.results[r1]),
+                                  _solo(params, cfg, p1, 10))
+    np.testing.assert_array_equal(np.asarray(eng.results[r2]),
+                                  _solo(params, cfg, p2, 10))
+    assert eng.pool.allocated_blocks == 0
+
+
+def test_swap_in_vs_reprefill_bitwise_parity(tiny_lm):
+    """The two resume paths are interchangeable: the same overcommitted
+    trace restored by host swap-in and by re-prefill yields IDENTICAL
+    token streams, and the swap store's sha round trip is exercised."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, cfg.vocab_size, 6).astype(np.int32)
+               for _ in range(4)]
+
+    def run(swap):
+        eng = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                     num_blocks=10, admission="optimistic", swap=swap)
+        rids = [eng.submit(p, 12) for p in prompts]
+        eng.run_until_idle()
+        return eng, [list(eng.results[r]) for r in rids]
+
+    e_swap, out_swap = run("host")
+    e_re, out_re = run("recompute")
+    assert out_swap == out_re
+    assert e_swap.metrics.swap_ins >= 1  # the host path actually ran
+    assert e_swap.metrics.swap_bytes_out > 0
+    assert e_swap.metrics.swap_bytes_in > 0
+    assert e_re.metrics.reprefills >= 1
+    assert e_re.metrics.swap_outs == 0  # recompute never stages bytes
+    assert len(e_swap._swap_store) == 0  # every record consumed
+
+
+def test_swap_corruption_falls_back_to_reprefill(tiny_lm):
+    """A swap record that fails its sha check must NOT re-enter the pool:
+    the resume degrades to re-prefill, counted, with parity intact."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    prompt = np.arange(1, 7, dtype=np.int32)
+    eng = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                 admission="quantile", swap="host")
+    rid = eng.submit(prompt, 10)
+    for _ in range(3):
+        eng.step()
+    assert eng.preempt(rid)
+    rec = eng._swap_store._recs[rid]
+    rec.arrays["k"].flat[0] += 1.0  # rot one element in host memory
+    eng.run_until_idle()
+    assert eng.metrics.swap_fallbacks == 1
+    assert eng.metrics.reprefills == 1
+    assert eng.metrics.swap_ins == 0
+    np.testing.assert_array_equal(np.asarray(eng.results[rid]),
+                                  _solo(params, cfg, prompt, 10))
+
+
+def test_victim_mid_speculation_parks_draft_cache(tiny_lm):
+    """A speculative engine's victim parks its DRAFT cache rows too: the
+    resumed request keeps proposing from its own history, and the greedy
+    stream stays solo-identical through the preemption."""
+    from gradaccum_tpu.models.gpt_decode import truncate_draft_params
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = truncate_draft_params(params, cfg, 1)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, cfg.vocab_size, 6).astype(np.int32)
+               for _ in range(4)]
+    for swap in ("host", "recompute"):
+        eng = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                     num_blocks=10, admission="optimistic", swap=swap,
+                     speculate_k=3, draft_params=dparams, draft_cfg=dcfg)
+        rids = [eng.submit(p, 12) for p in prompts]
+        eng.run_until_idle()
+        assert eng.metrics.preemptions >= 1
+        if swap == "host":
+            # the swap record carried the draft rows alongside the pool's
+            rec_count = eng.metrics.swap_ins
+            assert rec_count >= 1
+        for p, r in zip(prompts, rids):
+            np.testing.assert_array_equal(np.asarray(eng.results[r]),
+                                          _solo(params, cfg, p, 12))
+
+
+def test_preempt_then_cancel_cleans_everything(tiny_lm):
+    """Cancelling a PARKED request: partial tokens stay poppable, the
+    park snapshot and swap record are both gone, and the pool owes
+    nothing."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    prompt = np.arange(1, 7, dtype=np.int32)
+    eng = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                 admission="quantile", swap="host")
+    rid = eng.submit(prompt, 10)
+    for _ in range(3):
+        eng.step()
+    assert eng.preempt(rid)
+    assert rid in eng._swap_store
+    assert eng.cancel(rid) is True
+    tokens, status = eng.pop_result(rid)
+    assert status == "cancelled" and len(tokens) >= 1
+    assert rid not in eng._swap_store
+    assert not eng._parked_state
+    assert eng.scheduler.parked_depth == 0
+    assert eng.pool.allocated_blocks == 0
+    assert eng.cancel(rid) is False  # idempotent
+
+
+# -- admission accounting + labels -------------------------------------------
+
+
+def test_optimistic_beats_reserve_concurrency_at_equal_memory(tiny_lm):
+    """The point of the subsystem, in miniature: at the SAME pool memory,
+    optimistic admission runs strictly more requests concurrently than
+    worst-case reservations (requests declare long budgets, finish
+    short)."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+
+    def peak(admission):
+        eng = Engine(params, cfg, num_slots=8, max_len=32, page_size=4,
+                     num_blocks=12, admission=admission)
+        prompt = np.arange(1, 5, dtype=np.int32)
+        rids = [eng.submit(prompt, 20) for _ in range(6)]
+        peak_active = 0
+        while not eng.idle:
+            eng.step()
+            peak_active = max(peak_active, eng.pool.active_count)
+        assert all(eng.status[r] == "done" for r in rids)
+        return peak_active
+
+    # 4+20 tokens -> 6 pages each; 12 blocks fit TWO worst-case requests
+    assert peak(None) <= 2
+    assert peak("optimistic") >= 4
+
+
+def test_stall_and_bottleneck_labels_are_policy_aware(tiny_lm):
+    """With a policy gate holding while blocks are free, stalls and
+    QueueFull bottlenecks say "held by quantile gate"; the reserve-mode
+    engine's text is byte-for-byte what it always was."""
+    from gradaccum_tpu.serving import Engine, QueueFull, Scheduler
+
+    cfg, _, params = tiny_lm
+    # optimistic: r1 holds 2 of 4 blocks; r2's optimistic ask (2 blocks:
+    # 4-token prompt page + one decode page) exceeds min(unreserved,
+    # free) while the free list is NOT empty -> the gate is what holds
+    eng = Engine(params, cfg, num_slots=4, max_len=16, page_size=4,
+                 num_blocks=4, admission="optimistic",
+                 scheduler=Scheduler(max_queue=1))
+    r1 = eng.submit(np.ones(8, np.int32), 8)
+    eng.step()
+    eng.pool.alloc_to(0, 12)  # r1 grows into a third block
+    eng.submit(np.ones(4, np.int32), 8)
+    eng.step()
+    stalls = eng.scheduler.stalls
+    assert any("held_by_quantile_gate" in k for k in stalls), stalls
+    with pytest.raises(QueueFull, match="held by quantile gate"):
+        eng.submit(np.ones(4, np.int32), 8)
+
+    # reserve mode (no policy): the original text, unchanged
+    eng2 = Engine(params, cfg, num_slots=4, max_len=16, page_size=8,
+                  num_blocks=2, scheduler=Scheduler(max_queue=1))
+    eng2.submit(np.ones(4, np.int32), 8)
+    eng2.step()
+    eng2.submit(np.ones(4, np.int32), 8)
+    with pytest.raises(QueueFull, match="no free KV blocks"):
+        eng2.submit(np.ones(4, np.int32), 8)
+    eng2.step()
+    assert any(k == "no_free_blocks" for k in eng2.scheduler.stalls)
+    assert not any("quantile" in k for k in eng2.scheduler.stalls)
+
+
+def test_parked_queue_resumes_ahead_of_fresh_admissions(tiny_lm):
+    """A parked request that cannot yet re-enter HOLDS fresh admission
+    (recorded as parked_queue_ahead); once blocks free up it resumes
+    before the queued request is admitted, and both end solo-identical."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    p_hold = np.arange(1, 5, dtype=np.int32)
+    p_big = np.arange(1, 9, dtype=np.int32)
+    eng = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                 num_blocks=6, admission="optimistic")
+    r_hold = eng.submit(p_hold, 12)
+    eng.step()
+    r_big = eng.submit(p_big, 8)
+    eng.step()
+    assert eng.status[r_big] == "running"
+    assert eng.preempt(r_big) is True
+    # the survivor eats the freed blocks: the parked head cannot resume
+    hold_slot = next(s for s, req in enumerate(eng._slot_req)
+                     if req is not None)
+    eng.pool.alloc_to(hold_slot, 20)
+    assert eng.pool.free_blocks < 3  # less than r_big's live extent
+    r_fresh = eng.submit(p_hold, 4)
+    eng.step()
+    # fresh admission is held behind the preemption backlog
+    assert eng.status[r_big] == "preempted"
+    assert eng.status[r_fresh] == "queued"
+    assert any("parked_queue_ahead" in k for k in eng.scheduler.stalls), \
+        eng.scheduler.stalls
+    assert "parked requests ahead" in eng._bottleneck()
+    eng.run_until_idle()
+    for rid, prompt, n in ((r_hold, p_hold, 12), (r_big, p_big, 8),
+                           (r_fresh, p_hold, 4)):
+        np.testing.assert_array_equal(np.asarray(eng.results[rid]),
+                                      _solo(params, cfg, prompt, n))
+
+
+def test_preemption_storm_sentinel_fires_and_remediates():
+    """The preemption_storm anomaly: a sustained high preemption rate
+    fires once (level-held), routes through the stock remediation matrix
+    (recover + bounded requeue via the server contract), and resolves
+    when the rate subsides."""
+    from gradaccum_tpu.obs import sentinel as obs_sentinel
+    from gradaccum_tpu.obs.sentinel import Sentinel
+    from gradaccum_tpu.resilience import remediation
+
+    recovers = []
+
+    class FakeServer:
+        def request_recover(self, reason, replica=None):
+            recovers.append((reason, replica))
+
+    snt = Sentinel(preempt_warmup=2, preempt_consecutive=2,
+                   preempt_ceiling=0.5)
+    remediation.bind_default_remediations(snt, server=FakeServer())
+    for _ in range(4):
+        snt.observe_preemptions(0.9, replica=1)
+    fires = [a for a in snt.anomalies
+             if a.kind == obs_sentinel.PREEMPTION_STORM and a.state == "fire"]
+    assert len(fires) == 1  # level-held: one firing for the whole storm
+    assert fires[0].replica == 1
+    assert recovers and recovers[0][0] == "sentinel:preemption_storm replica 1"
+    assert recovers[0][1] == 1
+    snt.observe_preemptions(0.0, replica=1)
+    resolves = [a for a in snt.anomalies
+                if a.kind == obs_sentinel.PREEMPTION_STORM
+                and a.state == "resolve"]
+    assert len(resolves) == 1
+    assert snt.observe_preemptions(None) is None  # no-plane feed ignored
+
+
+def test_governor_tightens_admission_under_thrash(tiny_lm):
+    """A preemption burst arms the policy's governor: subsequent
+    admissions reserve worst case (observable as reservations covering
+    the full budget), then relax after the cooldown."""
+    from gradaccum_tpu.serving import AdmissionPolicy, Engine
+
+    cfg, _, params = tiny_lm
+    pol = AdmissionPolicy(mode="optimistic", storm_preempts=1,
+                          storm_window=8, cooldown=1000)
+    eng = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                 num_blocks=16, admission=pol)
+    prompt = np.arange(1, 5, dtype=np.int32)
+    r1 = eng.submit(prompt, 12)
+    eng.step()
+    assert eng.pool._slot_reserved[0] == 2  # optimistic: prompt + a page
+    assert eng.preempt(r1)  # arms the governor (storm_preempts=1)
+    assert pol.governed(eng.tick_count)
+    eng.run_until_idle()
+    r2 = eng.submit(prompt, 12)
+    eng.step()
+    slot = next(s for s, req in enumerate(eng._slot_req) if req is not None)
+    # governed: the full worst case (4 + 12 tokens = 4 pages) is reserved
+    assert eng.pool._slot_reserved[slot] == 4
+    eng.run_until_idle()
+
+
+def test_manifest_and_stats_carry_admission_knobs(tiny_lm):
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    eng = Engine(params, cfg, num_slots=2, max_len=16, page_size=4,
+                 admission="quantile", swap="recompute")
+    man = eng.manifest()
+    assert man["admission"] == "quantile"
+    assert man["admission_q"] == 0.85
+    assert man["swap"] == "recompute"
+    with ServingServer(eng) as srv:
+        h = srv.submit(np.ones(3, np.int32), 3)
+        h.result(timeout=60)
+        stats = srv.stats()
+    adm = stats["admission"]
+    assert adm["mode"] == "quantile"
+    assert adm["parked"] == 0
+    assert adm["governed"] is False
+
+    # a plain engine surfaces no admission block (and no policy at all)
+    eng2 = Engine(params, cfg, num_slots=2, max_len=16)
+    assert eng2.admission_policy is None
+    assert eng2.manifest()["admission"] is None
+
+
+def test_admission_rejects_invalid_knobs(tiny_lm):
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    with pytest.raises(ValueError, match="needs\npaged mode".replace("\n", " ")):
+        Engine(params, cfg, num_slots=2, max_len=16, admission="optimistic")
+    with pytest.raises(ValueError, match="swap must be"):
+        Engine(params, cfg, num_slots=2, max_len=16, swap="disk")
+    with pytest.raises(ValueError, match="unknown admission mode"):
+        Engine(params, cfg, num_slots=2, max_len=16, page_size=4,
+               admission="hopeful")
+    # reserve mode works on the fixed pool (it is the legacy gate)
+    eng = Engine(params, cfg, num_slots=2, max_len=16, admission="reserve")
+    assert eng.admission_policy.mode == "reserve"
+
+
+def test_reprefill_resume_honors_reduced_reservation(tiny_lm):
+    """A resume that could only validate the REDUCED (pressure-fallback)
+    reservation must reserve exactly that — not re-derive the full worst
+    case and crash (regression: the dispatch used to call reserve(limit)
+    regardless of what _resume_one had checked)."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    eng = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                 num_blocks=10, admission="optimistic", swap="recompute")
+    p1 = np.arange(1, 9, dtype=np.int32)
+    p2 = np.arange(2, 10, dtype=np.int32)
+    r1 = eng.submit(p1, 24)
+    r2 = eng.submit(p2, 24)
+    eng.step()
+    eng.step()
+    assert eng.preempt(r1)
+    # r2 still holds blocks+reservation: r1's full worst case (8 blocks)
+    # cannot reserve, so the resume must ride the reduced budget
+    eng.run_until_idle()
+    np.testing.assert_array_equal(np.asarray(eng.results[r1]),
+                                  _solo(params, cfg, p1, 24))
+    np.testing.assert_array_equal(np.asarray(eng.results[r2]),
+                                  _solo(params, cfg, p2, 24))
+    assert eng.metrics.reprefills >= 1
+
+
+def test_resume_records_queue_wait_exactly_once(tiny_lm):
+    """record_admit's contract survives preemption: one queue-wait sample
+    per request however many times it re-enters a slot (a resume's
+    dispatch rides the admission path, and a submit→resume-sized second
+    sample would poison the queue-wait SLO series)."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    prompt = np.arange(1, 7, dtype=np.int32)
+    for swap in ("host", "recompute"):
+        eng = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                     admission="quantile", swap=swap)
+        rid = eng.submit(prompt, 10)
+        for _ in range(3):
+            eng.step()
+        assert eng.preempt(rid)
+        eng.run_until_idle()
+        assert eng.status[rid] == "done"
+        assert len(eng.metrics.queue_wait) == 1, swap
+        # hit-rate denominators don't double-count resumes either
+        assert eng.metrics.prefix_misses == 0
+
+
+def test_parked_requests_honor_deadlines(tiny_lm):
+    """A preempted request is back to waiting: its deadline expires it
+    from the PARKED queue exactly like the fresh queue would, resume
+    state (swap record included) going with it."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    prompt = np.arange(1, 5, dtype=np.int32)
+    eng = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                 admission="quantile", swap="host")
+    # the deadline lapses the tick after admission; expiry runs before
+    # the parked-resume pass, so the expired request must never re-enter
+    rid = eng.submit(prompt, 20, deadline_ticks=0)
+    eng.step()
+    assert eng.preempt(rid)
+    assert rid in eng._swap_store
+    r2 = eng.submit(prompt, 4)  # queued behind the parked head
+    eng.step()
+    assert eng.status[rid] == "timeout"
+    assert rid not in eng._swap_store
+    assert not eng._parked_state
+    assert eng.scheduler.parked_depth == 0
+    tokens, status = eng.pop_result(rid)
+    assert status == "timeout" and len(tokens) >= 1  # partial stream kept
+    eng.run_until_idle()
+    assert eng.status[r2] == "done"  # the backlog cleared with the expiry
+
+
+# -- resilience interop -------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_preempted_requests_survive_engine_fault(tiny_lm):
+    """A tick crash while requests are parked: running ones requeue per
+    the PR-2 contract, PARKED ones resume on their own — and every
+    stream ends solo-identical."""
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.resilience.faults import (
+        FaultInjector,
+        FaultSchedule,
+        FaultSpec,
+    )
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, cfg.vocab_size, 6).astype(np.int32)
+               for _ in range(4)]
+    engine = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                    num_blocks=10, admission="optimistic")
+    inj = FaultInjector(FaultSchedule([FaultSpec(faults.MID_DECODE_TICK,
+                                                 at=4)]))
+    with faults.installed(inj):
+        with ServingServer(engine, max_requeues=2) as srv:
+            handles = [srv.submit(p, 12) for p in prompts]
+            results = [h.result(timeout=120) for h in handles]
+    assert inj.fired
+    for p, (toks, reason) in zip(prompts, results):
+        assert reason in ("eos", "length")
+        np.testing.assert_array_equal(np.asarray(toks),
+                                      _solo(params, cfg, p, 12))
+    assert engine.pool.allocated_blocks == 0
+
+
+@pytest.mark.faults
+def test_block_table_corruption_is_structured_and_heals(tiny_lm):
+    """The pool_page_table chaos kind: a corrupted row faults as
+    BlockTableCorruption at upload (never reaches a compiled program) and
+    the server's recover/requeue replays to parity."""
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.resilience.faults import (
+        FaultInjector,
+        FaultSchedule,
+        FaultSpec,
+    )
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    prompt = np.arange(1, 6, dtype=np.int32)
+    engine = Engine(params, cfg, num_slots=2, max_len=16, page_size=4)
+    inj = FaultInjector(FaultSchedule([
+        FaultSpec(faults.POOL_PAGE_TABLE, at=2, kind=faults.KIND_CORRUPT),
+    ]))
+    with faults.installed(inj):
+        with ServingServer(engine, max_requeues=2) as srv:
+            h = srv.submit(prompt, 6)
+            toks, reason = h.result(timeout=60)
+    assert inj.fired
+    np.testing.assert_array_equal(np.asarray(toks),
+                                  _solo(params, cfg, prompt, 6))
+    assert reason == "length"
+
+
+# -- bench (slow lane) --------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_admission_fast(tmp_path):
+    """The reserve/quantile/optimistic bench end-to-end at --fast shapes:
+    the artifact carries all three legs, the parity+preemption gates, and
+    the equal-memory acceptance holds even tiny."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from tools.bench_admission import main as bench_main
+
+    out = tmp_path / "BENCH_admission.json"
+    result = bench_main(["--fast", "--out", str(out)])
+    assert out.exists()
+    legs = {leg["admission"]: leg for leg in result["legs"]}
+    assert set(legs) == {"reserve", "quantile", "optimistic"}
+    for leg in legs.values():
+        assert leg["requests_per_1k_ticks"] > 0
+        assert leg["parity_ok"]
+    assert legs["optimistic"]["preemptions"] >= 1
+    assert result["acceptance"]["passed"]

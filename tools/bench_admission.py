@@ -1,0 +1,220 @@
+"""Admission-policy bench: reserve vs quantile vs optimistic, equal memory.
+
+The experiment the admission control plane exists for: a long-tail
+workload (every request DECLARES a long ``max_new_tokens`` budget, most
+finish near the p50 via eos) run through three engines that differ ONLY
+in the admission gate, at the SAME pool memory:
+
+- ``reserve``    — worst-case reservations (the PR-3 gate): concurrency
+                   capped by declared budgets that mostly never fill;
+- ``quantile``   — reserve at the observed length quantile (warms up on
+                   completed-request lengths, preempts when wrong);
+- ``optimistic`` — reserve the prompt + one page, preempt on pressure.
+
+Measured per leg on the deterministic tick clock: completed requests per
+1k ticks (admitted-requests/s on the logical clock), peak concurrency
+(max active slots over the run), preemption/swap counts, and a
+token-for-token greedy parity check of EVERY request against solo
+``generate_cached`` — preemption must never show in results. Acceptance:
+the best overcommitting leg clears >= 1.5x reserve on requests/s OR peak
+concurrency, parity everywhere, and at least one REAL forced preemption
+in the optimistic leg (otherwise the bench proved nothing about safety).
+
+Writes ``BENCH_admission.json`` (``tools/bench_trend.py`` folds it in).
+Usage: python tools/bench_admission.py [--fast] [--out PATH]
+"""
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _make_workload(params, cfg, n_requests, declared_new, seed,
+                   long_every=5):
+    """Long-tail traffic: each request declares ``declared_new`` tokens
+    but most stop early at a per-request eos chosen (from the request's
+    OWN solo greedy stream) to land near a geometric target length —
+    requests that never repeat a token run their full budget, which IS
+    the long tail."""
+    import numpy as np
+
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+
+    rng = np.random.default_rng(seed)
+    items = []
+    for i in range(n_requests):
+        prompt = rng.integers(0, cfg.vocab_size, 5).astype(np.int32)
+        solo = np.asarray(generate_cached(params, cfg, prompt,
+                                          declared_new))[0, prompt.size:]
+        if i % long_every == long_every - 1:
+            # every long_every-th request IS the long tail: no eos, full
+            # budget — what builds mid-stream pressure under overcommit
+            items.append({"prompt": prompt, "eos": None,
+                          "want": list(solo)})
+            continue
+        target = min(int(rng.geometric(0.25)) + 2, declared_new - 1)
+        # candidate stop points: positions whose token first occurs there
+        stops = [k for k in range(1, len(solo))
+                 if solo[k] not in solo[:k]]
+        eos = None
+        if stops:
+            k = min(stops, key=lambda s: abs(s - (target - 1)))
+            eos = int(solo[k])
+            want = list(solo[:k + 1])
+        else:
+            want = list(solo)
+        items.append({"prompt": prompt, "eos": eos, "want": want})
+    return items
+
+
+def _run_leg(params, cfg, items, admission, *, num_slots, page_size,
+             num_blocks, declared_new, max_len):
+    import numpy as np  # noqa: F401
+
+    from gradaccum_tpu.serving import AdmissionPolicy, Engine, Scheduler
+
+    name = (admission.mode if isinstance(admission, AdmissionPolicy)
+            else (admission or "reserve"))
+    engine = Engine(params, cfg, num_slots=num_slots, max_len=max_len,
+                    page_size=page_size, num_blocks=num_blocks,
+                    admission=admission,
+                    scheduler=Scheduler(max_queue=len(items)))
+    rids = [engine.submit(it["prompt"], declared_new, eos_id=it["eos"])
+            for it in items]
+    peak = 0
+    ticks = 0
+    while not engine.idle:
+        engine.step()
+        ticks += 1
+        peak = max(peak, engine.pool.active_count)
+        if ticks > 100_000:
+            raise RuntimeError("leg did not drain")
+    parity = all(
+        list(engine.results[r]) == it["want"]
+        and engine.status[r] == "done"
+        for r, it in zip(rids, items)
+    )
+    m = engine.metrics
+    return {
+        "admission": name,
+        "ticks_to_drain": ticks,
+        "requests_per_1k_ticks": round(len(items) / ticks * 1000, 2),
+        "peak_concurrency": peak,
+        "preemptions": m.preemptions,
+        "swap_ins": m.swap_ins,
+        "reprefills": m.reprefills,
+        "swap_bytes_out": m.swap_bytes_out,
+        "parked_peak": m.parked_peak,
+        "parity_ok": bool(parity),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fast", action="store_true",
+                    help="tiny shapes for the slow-lane CI gate")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default: <repo>/BENCH_admission.json)")
+    args = ap.parse_args(argv)
+
+    import jax
+    import numpy as np  # noqa: F401  (workload helpers)
+
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(jax.random.PRNGKey(0),
+                         {"input_ids": np.zeros((1, 8), np.int32)})
+
+    n_requests = 10 if args.fast else 28
+    declared_new = 20
+    # --fast shrinks the pool too: with fewer requests the full-size pool
+    # never comes under pressure, and an optimistic leg that never
+    # preempts proves nothing about overcommit safety
+    shapes = dict(num_slots=8, page_size=4,
+                  num_blocks=10 if args.fast else 16,
+                  declared_new=declared_new, max_len=32)
+    print(f"[bench_admission] workload: {n_requests} requests, declared "
+          f"max_new={declared_new}, pool={shapes['num_blocks']} blocks x "
+          f"{shapes['page_size']} tokens (equal across legs)")
+    items = _make_workload(params, cfg, n_requests, declared_new, args.seed,
+                           long_every=4 if args.fast else 5)
+    actual = sorted(len(it["want"]) for it in items)
+    print(f"[bench_admission] actual lengths p50={actual[len(actual)//2]} "
+          f"max={actual[-1]} (declared {declared_new})")
+
+    from gradaccum_tpu.serving import AdmissionPolicy
+
+    legs = []
+    for admission in (None,
+                      # q below the long-tail fraction, so the estimate
+                      # tracks the p50 crowd instead of the tail's
+                      # worst-case declarations
+                      AdmissionPolicy(mode="quantile", q=0.75,
+                                      min_samples=6),
+                      "optimistic"):
+        leg = _run_leg(params, cfg, items, admission, **shapes)
+        legs.append(leg)
+        print(f"[bench_admission] {leg['admission']:>10}: "
+              f"{leg['requests_per_1k_ticks']} req/1k ticks, peak "
+              f"concurrency {leg['peak_concurrency']}, "
+              f"{leg['preemptions']} preemptions, parity "
+              f"{'OK' if leg['parity_ok'] else 'BROKEN'}")
+
+    base = legs[0]
+    best_rate = max(leg["requests_per_1k_ticks"] for leg in legs[1:])
+    best_peak = max(leg["peak_concurrency"] for leg in legs[1:])
+    rate_x = best_rate / base["requests_per_1k_ticks"]
+    peak_x = best_peak / base["peak_concurrency"]
+    opt = next(leg for leg in legs if leg["admission"] == "optimistic")
+    parity = all(leg["parity_ok"] for leg in legs)
+    passed = (max(rate_x, peak_x) >= 1.5 and parity
+              and opt["preemptions"] >= 1)
+    headline = (f"{rate_x:.2f}x requests/s, {peak_x:.2f}x peak concurrency "
+                f"vs reserve at equal pool memory "
+                f"({opt['preemptions']} preemptions, parity clean)")
+    print(f"[bench_admission] {headline}")
+
+    artifact = {
+        "bench": "admission policy: reserve vs quantile vs optimistic "
+                 "(CPU, tick clock)",
+        "headline": headline,
+        "seed": args.seed,
+        "workload": {
+            "requests": n_requests,
+            "declared_max_new": declared_new,
+            "actual_p50": actual[len(actual) // 2],
+            "actual_max": actual[-1],
+            **shapes,
+        },
+        "legs": legs,
+        "admitted_rate_x": round(rate_x, 3),
+        "peak_concurrency_x": round(peak_x, 3),
+        "acceptance": {
+            "required": ">= 1.5x admitted-requests/s or peak concurrency "
+                        "vs the reserve baseline at equal pool memory, "
+                        "greedy token parity on every leg, and >= 1 forced "
+                        "preemption in the optimistic leg",
+            "passed": bool(passed),
+        },
+    }
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_admission.json",
+    )
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    print(f"[bench_admission] {'PASS' if passed else 'FAIL'}; wrote {out}")
+    return artifact
+
+
+if __name__ == "__main__":
+    artifact = main()
+    sys.exit(0 if artifact["acceptance"]["passed"] else 1)

@@ -75,6 +75,24 @@ def _spec_column(data) -> str:
     return "accept sweep " + ", ".join(parts)
 
 
+def _admission_column(data) -> str:
+    """Render an admission ``legs`` list (BENCH_admission.json) as the
+    reserve→policy requests-per-tick ladder with preemption counts."""
+    legs = data.get("legs")
+    if not isinstance(legs, list) or not legs:
+        return ""
+    try:
+        parts = [
+            f"{leg['admission']} {float(leg['requests_per_1k_ticks']):g}"
+            f"/1k (peak {int(leg['peak_concurrency'])}, "
+            f"{int(leg['preemptions'])} preempt)"
+            for leg in legs
+        ]
+    except (KeyError, TypeError, ValueError):
+        return ""
+    return "admission " + " → ".join(parts)
+
+
 def _memory_column(data) -> str:
     """Render a mixed-precision ``rows`` ladder (BENCH_mixed.json) as the
     per-replica optimizer+accumulator bytes/param progression."""
@@ -122,6 +140,7 @@ def collect(bench_dir: str):
             "overhead": _overhead_column(data) or None,
             "memory": _memory_column(data) or None,
             "spec": _spec_column(data) or None,
+            "admission": _admission_column(data) or None,
             "acceptance": acceptance,
             "passed": None if acceptance is None
             else bool(acceptance.get("passed")),
@@ -190,6 +209,8 @@ def main(argv=None) -> int:
                 detail += f" — {r['memory']}"
             if r.get("spec"):
                 detail += f" — {r['spec']}"
+            if r.get("admission"):
+                detail += f" — {r['admission']}"
             if required != "":
                 detail += f" [{required}]"
             if not r["passed"]:

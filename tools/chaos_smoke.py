@@ -1,16 +1,24 @@
 """One-command seeded chaos run over train + serve.
 
-Draws a random fault schedule from ``--seed`` (crash mid-train, an
-overflow storm, an IO error inside a checkpoint write, a decode-tick crash
-and a slow tick on the serving side), runs a small training job to
-completion THROUGH the faults — resuming from the newest checkpoint after
-every injected kill, exactly like an operator would — then runs a serving
-burst through its own faults. Asserts the end state is healthy:
+Draws ONE random fault schedule from ``--seed`` — a single rng stream at
+the top decides every phase's fault parameters (crash mid-train, an
+overflow storm, an IO error inside a checkpoint write, a decode-tick
+crash and a slow tick on the serving side, a page-table corruption and a
+swap-IO failure on the paged admission side) — then runs a small training
+job to completion THROUGH the faults — resuming from the newest
+checkpoint after every injected kill, exactly like an operator would —
+and a serving burst through its own faults. Asserts the end state is
+healthy:
 
 - training reached ``max_steps`` with a non-empty, restorable final
   checkpoint and all-finite params;
 - the loss-scale series halved and regrew through the storm;
-- every serving request completed with greedy parity vs solo decode.
+- every serving request completed with greedy parity vs solo decode;
+- the paged/prefix admission plane survives its own fault kinds: a
+  corrupted page-table row faults STRUCTURED (``BlockTableCorruption``)
+  and heals through recover/requeue with parity, and a swap-IO error
+  degrades to re-prefill (counted as a swap fallback) without losing a
+  token.
 
 The ops-plane phase closes the detect→remediate loop: every injected
 fault class raises its MATCHING alert (tick crash → ``engine_fault``,
@@ -37,7 +45,26 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def _train_chaos(seed: int, work_dir: str, log):
+def draw_plan(seed: int) -> dict:
+    """ONE seeded schedule for every phase: a single rng stream decides
+    train, serve, and paged-pool fault parameters up front, so the whole
+    cross-phase chaos run replays from one number (ROADMAP ops item a —
+    previously each phase drew its own plan from a derived seed)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    K = 4
+    return {
+        "train_crash_at": int(rng.integers(10, 30)),
+        "storm_start": int(rng.integers(30, 36)),
+        "storm_len": int(rng.integers(K, 2 * K)),
+        "serve_crash_tick": int(rng.integers(1, 5)),
+        "serve_slow_offset": 3,
+        "paged_table_tick": int(rng.integers(2, 6)),
+    }
+
+
+def _train_chaos(seed: int, work_dir: str, log, plan):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -56,7 +83,6 @@ def _train_chaos(seed: int, work_dir: str, log):
     )
 
     K, n_steps = 4, 48
-    rng = np.random.default_rng(seed)
 
     def init(prng, sample):
         del prng, sample
@@ -81,11 +107,12 @@ def _train_chaos(seed: int, work_dir: str, log):
         )
         data.append({"x": x, "y": y})
 
-    # the seeded chaos plan: a kill, a storm, a flaky disk — all at once
-    crash_at = int(rng.integers(10, 30))
-    storm = FaultSchedule.overflow_storm(
-        seed, start_range=(30, 36), length_range=(K, 2 * K)
-    ).specs[0]
+    # this phase's slice of the ONE seeded plan: a kill, a storm, a
+    # flaky disk — all at once
+    crash_at = plan["train_crash_at"]
+    storm = FaultSpec(faults.PRE_TRAIN_STEP, at=plan["storm_start"],
+                      kind=faults.KIND_OVERFLOW_STORM,
+                      span=plan["storm_len"])
     specs = [
         FaultSpec(faults.POST_TRAIN_STEP, at=crash_at),
         storm,
@@ -181,7 +208,7 @@ def _train_chaos(seed: int, work_dir: str, log):
             "final_step": int(jax.device_get(state.step))}
 
 
-def _serve_chaos(seed: int, log):
+def _serve_chaos(seed: int, log, plan):
     import jax
     import numpy as np
 
@@ -207,14 +234,15 @@ def _serve_chaos(seed: int, log):
         for _ in range(6)
     ]
 
-    crash_tick = int(rng.integers(1, 5))
+    crash_tick = plan["serve_crash_tick"]
+    slow_tick = crash_tick + plan["serve_slow_offset"]
     specs = [
         FaultSpec(faults.MID_DECODE_TICK, at=crash_tick),
-        FaultSpec(faults.MID_DECODE_TICK, at=crash_tick + 3,
+        FaultSpec(faults.MID_DECODE_TICK, at=slow_tick,
                   kind=faults.KIND_SLOW_TICK, delay=0.05),
     ]
     log(f"[chaos/serve] plan: tick crash@{crash_tick}, "
-        f"slow tick@{crash_tick + 3}")
+        f"slow tick@{slow_tick}")
     import tempfile
 
     from gradaccum_tpu.obs import flight as obs_flight
@@ -273,6 +301,81 @@ def _serve_chaos(seed: int, log):
     return {"requests": len(results),
             "flight_dumps": n_flight_dumps,
             "faults_fired": list(injector.fired)}
+
+
+def _paged_chaos(seed: int, log, plan):
+    """The admission-plane fault kinds (ROADMAP ops item a): a corrupted
+    page-table row must fault STRUCTURED at upload and heal through the
+    existing recover/requeue contract, and a swap-IO error during
+    preemption must degrade to re-prefill — both with every request's
+    greedy stream token-identical to solo decode."""
+    import jax
+    import numpy as np
+
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.resilience.faults import (
+        FaultInjector,
+        FaultSchedule,
+        FaultSpec,
+    )
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    rng = np.random.default_rng(seed + 7)
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(jax.random.PRNGKey(0),
+                         {"input_ids": np.zeros((1, 8), np.int32)})
+    sys_prompt = rng.integers(0, cfg.vocab_size, 8).astype(np.int32)
+    prompts = [
+        np.concatenate([sys_prompt,
+                        rng.integers(0, cfg.vocab_size, 3).astype(np.int32)])
+        for _ in range(5)
+    ]
+    # a tight block pool + optimistic admission so preemption (and with it
+    # the swap path the IO fault targets) actually happens
+    engine = Engine(params, cfg, num_slots=5, max_len=32, page_size=4,
+                    num_blocks=14, prefix_cache=True,
+                    admission="optimistic", swap="host")
+    table_tick = plan["paged_table_tick"]
+    specs = [
+        FaultSpec(faults.POOL_PAGE_TABLE, at=table_tick,
+                  kind=faults.KIND_CORRUPT),
+        FaultSpec(faults.MID_SWAP_IO, at=None,
+                  kind=faults.KIND_IO_ERROR, count=1),
+    ]
+    log(f"[chaos/paged] plan: page-table corrupt@{table_tick}, "
+        "swap-IO error on the first swap")
+    injector = FaultInjector(FaultSchedule(specs))
+    with faults.installed(injector):
+        server = ServingServer(engine, max_requeues=3).start()
+        handles = [server.submit(p, 12) for p in prompts]
+        results = [h.result(timeout=180) for h in handles]
+        server.stop()  # must not raise: both faults were absorbed
+
+    kinds = {(p, k) for p, _, k in injector.fired}
+    assert (faults.POOL_PAGE_TABLE, faults.KIND_CORRUPT) in kinds, \
+        "the page-table corruption never fired"
+    m = engine.metrics
+    if (faults.MID_SWAP_IO, faults.KIND_IO_ERROR) in kinds:
+        assert m.swap_fallbacks >= 1, \
+            "swap-IO error fired but no fallback was counted"
+    for prompt, (tokens, reason) in zip(prompts, results):
+        assert reason in ("eos", "length"), reason
+        want = np.asarray(generate_cached(params, cfg, prompt, 12))
+        np.testing.assert_array_equal(np.asarray(tokens),
+                                      want[0, prompt.size:])
+    assert engine.idle
+    assert engine.pool.allocated_blocks == 0
+    log(f"[chaos/paged] PASS: {len(results)} requests parity-clean through "
+        f"{len(injector.fired)} fault(s); preemptions={m.preemptions}, "
+        f"swap_fallbacks={m.swap_fallbacks}, reprefills={m.reprefills}")
+    return {"requests": len(results),
+            "faults_fired": list(injector.fired),
+            "preemptions": m.preemptions,
+            "swap_fallbacks": m.swap_fallbacks,
+            "reprefills": m.reprefills}
 
 
 def _ops_chaos(seed: int, log):
@@ -494,29 +597,38 @@ def main(argv=None) -> int:
     log = print
     import tempfile
 
-    required = ("seeded chaos (train kill+storm+ckpt IO, serve tick "
-                "crash+slow tick): clean resume, non-empty final "
+    required = ("ONE seeded schedule across train+serve (kill+storm+ckpt "
+                "IO, serve tick crash+slow tick, paged page-table "
+                "corruption+swap-IO error): clean resume, non-empty final "
                 "checkpoint, greedy serving parity, every injected fault "
-                "in a flight-recorder dump with downstream activity; ops "
-                "plane: each fault class raises its matching alert "
-                "(crash->engine_fault, slow_tick->latency_cliff, "
-                "overflow_storm->scale_storm), sentinel remediation fires "
-                "through the recover/requeue/drain contract with the "
-                "post-remediation stream token-parity clean, and seeded "
-                "simulation alert streams are byte-identical")
+                "in a flight-recorder dump with downstream activity; the "
+                "paged admission plane heals table corruption via "
+                "recover/requeue and degrades swap-IO to re-prefill, "
+                "parity-clean; ops plane: each fault class raises its "
+                "matching alert (crash->engine_fault, "
+                "slow_tick->latency_cliff, overflow_storm->scale_storm), "
+                "sentinel remediation fires through the "
+                "recover/requeue/drain contract with the post-remediation "
+                "stream token-parity clean, and seeded simulation alert "
+                "streams are byte-identical")
     passed = False
     detail = {}
     from gradaccum_tpu.obs.trace import Tracer
     from gradaccum_tpu.obs.trace import installed as tracer_installed
 
     try:
-        # one unbounded tracer across both phases: every fault, recover,
+        # ONE seeded schedule for every phase, drawn before anything runs
+        plan = draw_plan(args.seed)
+        detail["plan"] = dict(plan)
+        log(f"[chaos] unified plan: {plan}")
+        # one unbounded tracer across all phases: every fault, recover,
         # resume and request lands on a single correlated timeline, and
         # nothing is ring-evicted before the assertions read it back
         with tracer_installed(Tracer(capacity=None)):
             with tempfile.TemporaryDirectory() as work:
-                detail["train"] = _train_chaos(args.seed, work, log)
-            detail["serve"] = _serve_chaos(args.seed, log)
+                detail["train"] = _train_chaos(args.seed, work, log, plan)
+            detail["serve"] = _serve_chaos(args.seed, log, plan)
+            detail["paged"] = _paged_chaos(args.seed, log, plan)
             detail["ops"] = _ops_chaos(args.seed, log)
         passed = True
     except AssertionError as e:
