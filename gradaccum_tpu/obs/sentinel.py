@@ -44,6 +44,7 @@ seeded simulation produces a byte-identical anomaly log.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import threading
@@ -192,6 +193,10 @@ class Sentinel:
         self.anomalies: List[Anomaly] = []  # the log (fire + resolve)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # planned-maintenance depth: lease checks pause while > 0 (a live
+        # reconfiguration stops every heartbeat on purpose — that silence
+        # must not read as stall/dead_replica)
+        self._maintenance = 0
 
     @property
     def tracer(self):
@@ -400,14 +405,39 @@ class Sentinel:
                    remediate=False)
         self._resolve(ENGINE_FAULT, replica, t)
 
+    # -- planned maintenance ----------------------------------------------
+
+    @contextlib.contextmanager
+    def maintenance(self):
+        """Pause lease-expiry checks across a PLANNED interruption (live
+        reconfiguration, checkpoint swap): every loop stops heartbeating
+        while the engine rebuilds, and that silence must not fire
+        stall/dead_replica. Reentrant. On exit, every lease restarts at
+        the current clock so the maintenance window itself never counts
+        against the next check."""
+        with self._lock:
+            self._maintenance += 1
+        try:
+            yield self
+        finally:
+            now = self.clock()
+            with self._lock:
+                self._maintenance -= 1
+                if self._maintenance == 0:
+                    self._hb = {r: (now, tick, busy)
+                                for r, (_, tick, busy) in self._hb.items()}
+
     # -- the lease check ---------------------------------------------------
 
     def check(self, now: Optional[float] = None) -> List[Anomaly]:
         """Evaluate heartbeat leases; returns anomalies fired by THIS
         call. A replica whose last heartbeat said ``busy`` and is older
-        than ``lease`` is stalled (single engine) or dead (fleet)."""
+        than ``lease`` is stalled (single engine) or dead (fleet). A
+        no-op inside a :meth:`maintenance` window."""
         t = self.clock() if now is None else float(now)
         with self._lock:
+            if self._maintenance:
+                return []
             expired = [
                 (replica, hb_t, tick)
                 for replica, (hb_t, tick, busy) in self._hb.items()

@@ -27,6 +27,13 @@ Fault points (the ``index`` each site passes):
   count. The ``corrupt`` kind pokes an out-of-range block id into a live
   page-table row; the pool's upload-time bounds check turns it into a
   structured engine fault the recover/requeue contract heals.
+- ``MID_RECONFIG`` — inside ``Engine.reconfigure``, fired TWICE per
+  reconfiguration: index ``2n`` after the preempt-all (old config,
+  everything parked) and ``2n + 1`` after the rebuild (new config,
+  everything parked), where ``n`` is the engine's reconfig count. A
+  ``crash`` at either index lands in a clean old-or-new configuration —
+  never a torn pool — and the parked requests drain through the ordinary
+  resume path.
 
 Kinds: ``crash`` raises :class:`InjectedCrash` (simulated process death —
 deliberately NOT an OSError, so IO retry loops never swallow it);
@@ -63,8 +70,9 @@ MID_CKPT_WRITE = "mid_checkpoint_write"
 MID_DECODE_TICK = "mid_decode_tick"
 MID_SWAP_IO = "mid_swap_io"
 POOL_PAGE_TABLE = "pool_page_table"
+MID_RECONFIG = "mid_reconfig"
 POINTS = (PRE_TRAIN_STEP, POST_TRAIN_STEP, MID_CKPT_WRITE, MID_DECODE_TICK,
-          MID_SWAP_IO, POOL_PAGE_TABLE)
+          MID_SWAP_IO, POOL_PAGE_TABLE, MID_RECONFIG)
 
 KIND_CRASH = "crash"
 KIND_IO_ERROR = "io_error"

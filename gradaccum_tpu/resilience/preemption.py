@@ -146,22 +146,119 @@ class LocalDrainBus:
     step)``, and every participant receives the identical result — the
     same semantics as the ``jax.distributed`` all-reduce, minus the
     cluster. Used by the tier-1 multi-host drain gate.
+
+    **Host liveness leases** (``lease_ttl``): every host renews a
+    per-host lease key via :meth:`renew` (the serving loop / train loop
+    heartbeat). While a round waits for stragglers, the bus distinguishes
+    *slow* from *gone*: a missing host whose lease was renewed within
+    ``lease_ttl`` is slow — keep waiting — while one whose lease EXPIRED
+    (it was alive once and stopped renewing; a never-renewed host is
+    merely unknown, maybe late to start, and never shortcuts the
+    barrier) is gone, and once every missing host is provably gone the
+    round resolves with the survivors' submissions immediately instead
+    of waiting out the full barrier ``timeout``. A partially-resolved
+    round counts in ``partial_rounds`` and names the absent hosts in
+    :meth:`last_partial`. Without ``lease_ttl`` the behavior is exactly
+    the old all-or-timeout barrier. ``clock`` is injectable so the
+    slow-vs-gone gate is deterministic in tests.
+
+    Partial resolution is for hosts that have terminally departed. A
+    declared-gone host that nonetheless returns rejoins at the CURRENT
+    round (its submission pairs with the survivors' next decision), so
+    one decision may be skewed; callers latch the first positive
+    decision (the train loop does) and drain/reconfig decisions are
+    any-requested/max-value, which makes the skew benign — pick
+    ``lease_ttl`` well above worst-case pauses so a live host is never
+    declared gone in the first place.
     """
 
-    def __init__(self, num_hosts: int, timeout: float = 60.0):
+    def __init__(self, num_hosts: int, timeout: float = 60.0,
+                 lease_ttl: Optional[float] = None, clock=None):
         if num_hosts < 1:
             raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+        if lease_ttl is not None and lease_ttl <= 0:
+            raise ValueError(f"lease_ttl must be > 0, got {lease_ttl}")
         self.num_hosts = num_hosts
         self.timeout = timeout
+        self.lease_ttl = lease_ttl
+        import time as _time
+
+        self.clock = clock if clock is not None else _time.monotonic
         self._cond = threading.Condition()
         self._round = 0
         self._submitted: Dict[int, Tuple[bool, int]] = {}
         self._results: Dict[int, Tuple[bool, int]] = {}
+        self._leases: Dict[int, float] = {}
+        self.partial_rounds = 0     # rounds resolved without every host
+        self._last_partial: Tuple[int, ...] = ()
+
+    # -- liveness leases ---------------------------------------------------
+
+    def renew(self, host_id: int, now: Optional[float] = None) -> None:
+        """Renew ``host_id``'s liveness lease (one cheap write per
+        heartbeat; hosts renew far more often than they exchange)."""
+        t = self.clock() if now is None else float(now)
+        with self._cond:
+            self._leases[int(host_id)] = t
+            self._cond.notify_all()
+
+    def lease_status(self, host_id: int,
+                     now: Optional[float] = None) -> str:
+        """``"live"`` (renewed within ``lease_ttl``), ``"expired"``, or
+        ``"unknown"`` (never renewed). Only EXPIRED counts as gone for
+        the partial resolve — an unknown host may not have started yet,
+        and only proven departure may shortcut the barrier."""
+        if self.lease_ttl is None:
+            return "unknown"
+        t = self.clock() if now is None else float(now)
+        with self._cond:
+            at = self._leases.get(int(host_id))
+        if at is None:
+            return "unknown"
+        return "live" if t - at <= self.lease_ttl else "expired"
+
+    def last_partial(self) -> Tuple[int, ...]:
+        """Host ids absent from the most recent partially-resolved round
+        (empty when every round so far was full)."""
+        with self._cond:
+            return self._last_partial
+
+    def _gone(self, host_id: int) -> bool:
+        """Gone needs PROOF of departure: the host was alive (renewed at
+        least once) and then let its lease expire. A never-renewed host
+        may simply not have started yet — declaring it gone would
+        partial-resolve a round a healthy-but-late host then submits
+        into one generation behind, permanently skewing the barrier. It
+        degrades to the plain timeout path instead."""
+        if self.lease_ttl is None:
+            return False
+        at = self._leases.get(int(host_id))
+        return at is not None and self.clock() - at > self.lease_ttl
+
+    def _resolve_locked(self, this_round: int, partial: bool) -> None:
+        reqs = [r for r, _ in self._submitted.values()]
+        steps = [s for _, s in self._submitted.values()]
+        self._results[this_round] = (any(reqs), max(steps))
+        if partial:
+            self.partial_rounds += 1
+            self._last_partial = tuple(sorted(
+                h for h in range(self.num_hosts)
+                if h not in self._submitted
+            ))
+        # keep only a short tail so a long run cannot grow the map
+        for old in [r for r in self._results if r < this_round - 1]:
+            del self._results[old]
+        self._submitted = {}
+        self._round += 1
+        self._cond.notify_all()
 
     def exchange(self, host_id: int, requested: bool, step: int
                  ) -> Tuple[bool, int]:
         import time
 
+        # arriving at the barrier is itself proof of life
+        if self.lease_ttl is not None:
+            self.renew(host_id)
         with self._cond:
             if host_id in self._submitted:
                 raise RuntimeError(
@@ -171,22 +268,27 @@ class LocalDrainBus:
             this_round = self._round
             self._submitted[host_id] = (bool(requested), int(step))
             if len(self._submitted) == self.num_hosts:
-                reqs = [r for r, _ in self._submitted.values()]
-                steps = [s for _, s in self._submitted.values()]
-                self._results[this_round] = (any(reqs), max(steps))
-                # keep only a short tail so a long run cannot grow the map
-                for old in [r for r in self._results if r < this_round - 1]:
-                    del self._results[old]
-                self._submitted = {}
-                self._round += 1
-                self._cond.notify_all()
+                self._resolve_locked(this_round, partial=False)
             else:
                 # bounded wait: a peer that died (crashed step_fn, shorter
                 # stream) must not hang the survivors — DrainConsensus
                 # treats the timeout like any transport failure and drains
-                # locally
+                # locally. With leases armed the wait is sliced so the
+                # slow-vs-gone check runs between slices: every missing
+                # host provably gone -> resolve with the survivors NOW.
                 deadline = time.monotonic() + self.timeout
+                slice_s = (self.timeout if self.lease_ttl is None
+                           else min(self.timeout, max(self.lease_ttl / 4,
+                                                      1e-3)))
                 while this_round not in self._results:
+                    if (self.lease_ttl is not None
+                            and this_round == self._round
+                            and self._submitted
+                            and all(self._gone(h)
+                                    for h in range(self.num_hosts)
+                                    if h not in self._submitted)):
+                        self._resolve_locked(this_round, partial=True)
+                        break
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         raise TimeoutError(
@@ -194,7 +296,7 @@ class LocalDrainBus:
                             f"{len(self._submitted)}/{self.num_hosts} hosts "
                             f"arrived within {self.timeout}s"
                         )
-                    self._cond.wait(remaining)
+                    self._cond.wait(min(remaining, slice_s))
             return self._results[this_round]
 
 
@@ -233,6 +335,21 @@ class DrainConsensus:
     cluster). ``request()`` marks THIS participant preempted without a real
     signal — deterministic tests, cooperative shutdown; the SIGTERM path
     arrives through the ``requested`` argument instead.
+
+    **Per-host liveness leases** (``lease_ttl``): :meth:`renew_lease`
+    publishes a per-HOST heartbeat key on the consensus transport (the
+    bus's lease map, or ``{prefix}/lease/{pid}`` in the coordination
+    service's KV store) — the serving/train loop renews it every
+    iteration, far more often than it exchanges. Survivors then
+    distinguish *slow* (lease renewed late → keep waiting) from *gone*
+    (lease expired → proceed without waiting out the barrier timeout):
+    the bus transport resolves a round with the survivors the moment
+    every missing host is provably gone, and :meth:`peer_liveness` gives
+    the KV transport's view to operators and the reconfig plane. The
+    same consensus doubles as the fleet-wide reconfiguration scheduler —
+    ``serving/reconfig.py::agree_tick`` runs a (want-reconfig, tick)
+    round through ``decide`` on a dedicated instance, so every host
+    rebuilds at one agreed tick.
     """
 
     def __init__(
@@ -243,6 +360,7 @@ class DrainConsensus:
         interval: int = 1,
         timeout_ms: int = 60_000,
         key_prefix: str = "gradaccum/drain",
+        lease_ttl: Optional[float] = None,
     ):
         if multiprocess is None:
             import jax
@@ -253,12 +371,20 @@ class DrainConsensus:
                              "cannot combine with multiprocess=True")
         if interval < 1:
             raise ValueError(f"interval must be >= 1, got {interval}")
+        if lease_ttl is not None and lease_ttl <= 0:
+            raise ValueError(f"lease_ttl must be > 0, got {lease_ttl}")
         self.multiprocess = bool(multiprocess)
         self.bus = bus
         self.host_id = host_id
         self.interval = interval
         self.timeout_ms = timeout_ms
         self.key_prefix = key_prefix
+        self.lease_ttl = lease_ttl
+        if bus is not None and lease_ttl is not None \
+                and bus.lease_ttl is None:
+            # arm the bus's slow-vs-gone gate from this side too, so one
+            # constructor knob covers the simulated-host transport
+            bus.lease_ttl = lease_ttl
         self._local_request = False
         self._calls = 0
         self._round = 0
@@ -266,6 +392,66 @@ class DrainConsensus:
     def request(self) -> None:
         """Mark this host preempted (OR'd with the flag passed to decide)."""
         self._local_request = True
+
+    # -- per-host liveness leases -----------------------------------------
+
+    def renew_lease(self, now: Optional[float] = None) -> None:
+        """Publish this HOST's liveness heartbeat on the consensus
+        transport. Cheap (one KV write / one dict store) — call it every
+        loop iteration; a host that stops renewing past ``lease_ttl`` is
+        *gone* to its peers, not merely slow. No-op without a transport
+        or without ``lease_ttl``."""
+        if self.lease_ttl is None:
+            return
+        if self.bus is not None:
+            self.bus.renew(self.host_id, now=now)
+            return
+        if not self.multiprocess:
+            return
+        import time
+
+        try:
+            self._client().key_value_set(
+                f"{self.key_prefix}/lease/{self.host_id}",
+                repr(time.time() if now is None else float(now)))
+        except Exception:  # noqa: BLE001 — a lost lease write is survivable
+            pass
+
+    def peer_liveness(self, now: Optional[float] = None) -> Dict[int, str]:
+        """Every peer's lease verdict: ``"live"`` / ``"expired"`` /
+        ``"unknown"`` (never renewed). The bus transport reads its lease
+        map; the KV transport reads the ``lease/`` keys (wall-clock
+        timestamps — cluster hosts are NTP-close, and the TTL is seconds,
+        not milliseconds). Empty without ``lease_ttl``."""
+        if self.lease_ttl is None:
+            return {}
+        if self.bus is not None:
+            return {h: self.bus.lease_status(h, now=now)
+                    for h in range(self.bus.num_hosts)}
+        if not self.multiprocess:
+            return {self.host_id: "live"}
+        import jax
+        import time
+
+        t = time.time() if now is None else float(now)
+        out: Dict[int, str] = {}
+        try:
+            client = self._client()
+            for p in range(jax.process_count()):
+                try:
+                    raw = client.key_value_try_get(
+                        f"{self.key_prefix}/lease/{p}")
+                except Exception:  # noqa: BLE001 — absent key
+                    out[p] = "unknown"
+                    continue
+                try:
+                    out[p] = ("live" if t - float(raw) <= self.lease_ttl
+                              else "expired")
+                except (TypeError, ValueError):
+                    out[p] = "unknown"
+        except Exception:  # noqa: BLE001 — transport down: nothing to read
+            return {}
+        return out
 
     def decide(self, requested: bool, step: int) -> Tuple[bool, int]:
         req = bool(requested) or self._local_request

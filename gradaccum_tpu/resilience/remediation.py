@@ -52,6 +52,33 @@ def request_drain(consensus):
     return remedy
 
 
+def request_reconfig(server, spec_fn):
+    """Remediation callback: ask ``server`` (a :class:`ServingServer`) to
+    run a live reconfiguration at its next loop iteration — detection
+    closing the loop through ``serving/reconfig.py`` instead of a full
+    recover. ``spec_fn(anomaly)`` builds the
+    :class:`~gradaccum_tpu.serving.reconfig.ReconfigSpec` (returning
+    None skips — e.g. only shrink when the anomaly names a pool), so one
+    binding can e.g. shrink-on-pressure::
+
+        sentinel.on(obs_sentinel.PREEMPTION_STORM,
+                    remediation.request_reconfig(
+                        server, lambda a: reconfig.pool_resize(BIGGER)))
+
+    The reconfiguration runs on the loop thread under the engine lock
+    with the watchdog and sentinel leases suspended — the same quiesce →
+    preempt-all → rebuild → resume contract an operator-requested
+    reconfig takes."""
+
+    def remedy(anomaly):
+        spec = spec_fn(anomaly)
+        if spec is not None:
+            server.request_reconfig(spec)
+
+    remedy.__name__ = "request_reconfig"
+    return remedy
+
+
 def bind_default_remediations(sentinel, server=None, consensus=None):
     """The stock remediation matrix. Only the bindings whose target is
     provided are installed; returns ``sentinel`` for chaining.
@@ -65,6 +92,10 @@ def bind_default_remediations(sentinel, server=None, consensus=None):
     ``preemption_storm``      ``server`` recover + bounded requeue
     ``scale_storm``           ``consensus`` drain request
     ``engine_fault``          (none — the fault handler already ran)
+    (operator-bound)          :func:`request_reconfig` — e.g. bind
+                              ``preemption_storm`` to a pool grow
+                              (shrink-on-pressure's inverse) instead of
+                              the stock recover
     ========================= =====================================
 
     ``preemption_storm`` rides the same recover path on purpose: a pool

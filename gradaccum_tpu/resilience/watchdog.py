@@ -14,6 +14,7 @@ serving server only flips its error flag and fails handles).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Callable, Optional
@@ -21,7 +22,15 @@ from typing import Callable, Optional
 
 class Watchdog:
     """Fires ``on_stall(elapsed_seconds)`` once per armed window that
-    exceeds ``timeout``; re-arming starts a fresh window."""
+    exceeds ``timeout``; re-arming starts a fresh window.
+
+    :meth:`suspend` pauses the stall clock across PLANNED long
+    operations — a live reconfiguration's preempt-all + pool rebuild, or
+    a swap-heavy preemption burst — so a multi-second maintenance window
+    can never read as a wedged dispatch. While suspended, arming is a
+    no-op and the monitor never fires; on exit the next ``arm()`` starts
+    a fresh window (whatever window was open when suspension began is
+    forgotten — the time already spent was planned work, not a stall)."""
 
     def __init__(
         self,
@@ -41,12 +50,36 @@ class Watchdog:
         self._armed_at: Optional[float] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # reentrant suspension depth (reconfig suspends server-side AND
+        # engine-side around the same rebuild); int mutation under the
+        # GIL, read by the monitor thread — worst case one extra poll
+        self._suspended = 0
 
     def arm(self) -> None:
+        if self._suspended:
+            return  # a planned long operation is in progress
         self._armed_at = time.monotonic()
 
     def disarm(self) -> None:
         self._armed_at = None
+
+    @contextlib.contextmanager
+    def suspend(self):
+        """Pause stall detection for a planned long operation
+        (reentrant). A window open at entry RESTARTS fresh when the
+        outermost suspension exits — the planned work's duration never
+        counts against the stall budget, but the remainder of the armed
+        dispatch (e.g. the decode after a mid-tick swap burst) keeps its
+        stall detection instead of running unwatched."""
+        was_armed = self._armed_at is not None
+        self._suspended += 1
+        self._armed_at = None
+        try:
+            yield self
+        finally:
+            self._suspended -= 1
+            if self._suspended == 0 and was_armed:
+                self._armed_at = time.monotonic()
 
     def start(self) -> "Watchdog":
         if self._thread is not None:
@@ -66,7 +99,7 @@ class Watchdog:
     def _run(self) -> None:
         while not self._stop.wait(self._poll):
             armed_at = self._armed_at
-            if armed_at is None:
+            if armed_at is None or self._suspended:
                 continue
             elapsed = time.monotonic() - armed_at
             if elapsed > self.timeout:

@@ -32,7 +32,12 @@ pool, mid-stream ``PoolPressure`` preempts a refcount/prefix-liveness
 scored victim whose blocks swap to host memory (sha-checked round trip)
 or re-prefill at re-admission, parked requests resume ahead of fresh
 traffic token-for-token identical, and a thrash governor plus the
-``preemption_storm`` sentinel anomaly bound the churn. Admission
+``preemption_storm`` sentinel anomaly bound the churn. Live
+reconfiguration (``reconfig``) rides the same lifecycle:
+``Engine.reconfigure`` / ``ServingServer.request_reconfig`` resize the
+block pool, swap a sha-manifested checkpoint, or drain/activate a fleet
+replica UNDER traffic — every in-flight stream parks through the
+preempt path and resumes token-for-token at the new shape. Admission
 queueing with backpressure and deadlines lives in ``scheduler``; a threaded
 front-end plus a deterministic seeded simulation driver in ``server``
 (``ServingServer(free_running=True)`` runs one loop thread per replica of
@@ -56,7 +61,16 @@ from gradaccum_tpu.serving.cache_pool import (
     PrefixCache,
 )
 from gradaccum_tpu.serving.engine import Engine, StepEvents
-from gradaccum_tpu.serving.swap import HostSwapStore, SwapError
+from gradaccum_tpu.serving.reconfig import (
+    ReconfigError,
+    ReconfigResult,
+    ReconfigSpec,
+    checkpoint_swap,
+    pool_resize,
+    replica_activate,
+    replica_drain,
+)
+from gradaccum_tpu.serving.swap import HostSwapStore, SwapCapacityError, SwapError
 from gradaccum_tpu.serving.metrics import ServingMetrics
 from gradaccum_tpu.serving.replicated import ReplicatedEngine
 from gradaccum_tpu.serving.scheduler import QueueFull, Request, Scheduler
@@ -74,9 +88,17 @@ __all__ = [
     "PagedCachePool",
     "PoolPressure",
     "PrefixCache",
+    "SwapCapacityError",
     "SwapError",
     "Engine",
     "StepEvents",
+    "ReconfigError",
+    "ReconfigResult",
+    "ReconfigSpec",
+    "checkpoint_swap",
+    "pool_resize",
+    "replica_activate",
+    "replica_drain",
     "ReplicatedEngine",
     "ServingMetrics",
     "QueueFull",

@@ -26,6 +26,7 @@ results.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
@@ -607,6 +608,7 @@ class Engine:
         overlap_prefill: bool = False,
         admission=None,
         swap: str = "host",
+        swap_max_bytes: Optional[int] = None,
     ):
         if top_k is not None and temperature <= 0:
             raise ValueError("top_k sampling needs temperature > 0 "
@@ -671,9 +673,25 @@ class Engine:
                 "paged mode (page_size=...): overcommit is accounted in "
                 "KV blocks"
             )
-        self._swap_store = HostSwapStore() if swap == "host" else None
+        # swap_max_bytes BOUNDS the host store: a preemption storm evicts
+        # the oldest parked records (they degrade to re-prefill) instead
+        # of growing host memory without limit
+        self.swap_max_bytes = (None if swap_max_bytes is None
+                               else int(swap_max_bytes))
+        self._swap_store = (HostSwapStore(max_bytes=self.swap_max_bytes)
+                            if swap == "host" else None)
         # rid -> resume snapshot for parked (preempted) requests
         self._parked_state: Dict[int, _ParkedState] = {}
+        # -- live reconfiguration (serving/reconfig.py) -----------------
+        # True while a reconfigure() is quiescing/rebuilding (the
+        # structured "reconfiguring" stall label's source of truth)
+        self.reconfiguring = False
+        self._reconfig_count = 0
+        self.last_reconfig = None
+        # an attached ServingServer pins its tick watchdog here so the
+        # engine can suspend stall detection across planned long
+        # operations (reconfig rebuilds, swap-heavy preemption bursts)
+        self.watchdog = None
         # rid -> policy-budget tokens decided by this tick's admission
         # gate, consumed by _admit_dispatch's reserve call
         self._pending_budget: Dict[int, int] = {}
@@ -1001,6 +1019,7 @@ class Engine:
                             and self.admission_policy.mode == "quantile"
                             else None),
             "swap": self.swap_mode,
+            "swap_max_bytes": self.swap_max_bytes,
         }
 
     # -- request intake ---------------------------------------------------
@@ -1420,6 +1439,15 @@ class Engine:
             # streams stay byte-identical to before
             gauges.update(parked=self.scheduler.parked_depth,
                           preemptions=len(preempted))
+        if self._swap_store is not None and (
+                self.admission_policy is not None
+                or self._swap_store.held_bytes
+                or self.metrics.swap_store_bytes):
+            # the bounded host store's live footprint; the trailing
+            # condition keeps sampling through the decay back to zero
+            # after a storm without adding the gauge to engines that
+            # never park anything
+            gauges["swap_store_bytes"] = self._swap_store.held_bytes
         self.metrics.record_tick(self.scheduler.depth, self.pool.active_count,
                                  self.pool.num_slots, **gauges)
         self._tick = t + 1
@@ -1616,7 +1644,59 @@ class Engine:
             out = jax.device_put(out, sharding)
         return out
 
-    def _preempt(self, slot: int, preempted: List[int]) -> None:
+    def _stage_swap_out(self, slot: int, rid: int,
+                        length: int) -> Tuple[bool, int, int]:
+        """Stage a victim's live PRIVATE blocks (fixed pool: its whole
+        slot row) to the host store. Returns ``(swapped, page_start,
+        bytes_out)`` — ``page_start`` counts the leading shared-prefix
+        pages left alive in the pool for their other users."""
+        pool = self.pool
+        page_start = 0
+        arrays = None
+        if self.paged:
+            blocks = pool.blocks_of(slot)
+            live = min(pool.blocks_for(length), len(blocks))
+            for b in blocks[:live]:
+                if pool.refcount(b) == 1 and pool.owner_of(b) == slot:
+                    break
+                page_start += 1
+            tail = blocks[page_start:live]
+            # sharing is prefix-shaped, so the tail should be all
+            # private; anything else falls back to re-prefill rather
+            # than copying blocks out from under their other users
+            if tail and all(pool.refcount(b) == 1
+                            and pool.owner_of(b) == slot for b in tail):
+                kb, vb = self._gather_tail(tail)
+                arrays = {"k": kb, "v": vb}
+        else:
+            arrays = {
+                "k": np.asarray(jax.device_get(self.pool.k[:, slot])),
+                "v": np.asarray(jax.device_get(self.pool.v[:, slot])),
+            }
+        if arrays is None:
+            return False, 0, 0
+        if self.speculate_k:
+            # the victim is mid-speculation: park its draft cache
+            # rows too, or the resumed request's next draft cycle
+            # would propose from a stranger's K/V
+            arrays["draft_k"] = np.asarray(
+                jax.device_get(self._draft_k[:, slot]))
+            arrays["draft_v"] = np.asarray(
+                jax.device_get(self._draft_v[:, slot]))
+        try:
+            rec = self._swap_store.put(rid, arrays, page_start, length)
+            return True, page_start, rec.nbytes
+        except OSError:
+            # injected/real swap-IO failure (or a store capped by
+            # swap_max_bytes refusing an over-large record): the request
+            # resumes by re-prefill instead — swap is an optimization,
+            # never a correctness dependency
+            self._swap_store.discard(rid)
+            self.metrics.record_swap_fallback()
+            return False, 0, 0
+
+    def _preempt(self, slot: int, preempted: List[int],
+                 stage_swap: bool = True) -> None:
         """Evict the request in ``slot``: snapshot its resume point
         host-side, stage its live PRIVATE blocks to the host store (swap
         mode — shared prefix blocks are decref'd, never copied: their
@@ -1624,7 +1704,11 @@ class Engine:
         release the slot + blocks + reservation, and park the request
         ahead of all fresh admissions. Resumption is token-for-token
         identical either way: swap-in restores the exact K/V bytes,
-        re-prefill recomputes them from prompt + generated-so-far."""
+        re-prefill recomputes them from prompt + generated-so-far.
+        ``stage_swap=False`` parks without the device→host copy — for
+        callers that KNOW the bytes could never be restored (a weight
+        swap invalidating old K/V, a replica drain discarding the park
+        immediately)."""
         req = self._slot_req[slot]
         rid = req.request_id
         pool = self.pool
@@ -1639,48 +1723,13 @@ class Engine:
         swapped = False
         page_start = 0
         bytes_out = 0
-        if self._swap_store is not None:
-            arrays = None
-            if self.paged:
-                blocks = pool.blocks_of(slot)
-                live = min(pool.blocks_for(length), len(blocks))
-                for b in blocks[:live]:
-                    if pool.refcount(b) == 1 and pool.owner_of(b) == slot:
-                        break
-                    page_start += 1
-                tail = blocks[page_start:live]
-                # sharing is prefix-shaped, so the tail should be all
-                # private; anything else falls back to re-prefill rather
-                # than copying blocks out from under their other users
-                if tail and all(pool.refcount(b) == 1
-                                and pool.owner_of(b) == slot for b in tail):
-                    kb, vb = self._gather_tail(tail)
-                    arrays = {"k": kb, "v": vb}
-            else:
-                arrays = {
-                    "k": np.asarray(jax.device_get(self.pool.k[:, slot])),
-                    "v": np.asarray(jax.device_get(self.pool.v[:, slot])),
-                }
-            if arrays is not None:
-                if self.speculate_k:
-                    # the victim is mid-speculation: park its draft cache
-                    # rows too, or the resumed request's next draft cycle
-                    # would propose from a stranger's K/V
-                    arrays["draft_k"] = np.asarray(
-                        jax.device_get(self._draft_k[:, slot]))
-                    arrays["draft_v"] = np.asarray(
-                        jax.device_get(self._draft_v[:, slot]))
-                try:
-                    rec = self._swap_store.put(rid, arrays, page_start,
-                                               length)
-                    swapped = True
-                    bytes_out = rec.nbytes
-                except OSError:
-                    # injected/real swap-IO failure: the request resumes
-                    # by re-prefill instead — swap is an optimization,
-                    # never a correctness dependency
-                    self._swap_store.discard(rid)
-                    self.metrics.record_swap_fallback()
+        if self._swap_store is not None and stage_swap:
+            # swap-out stages whole blocks device->host; a burst of
+            # victims in one tick is planned work, not a stall, so the
+            # watchdog window pauses around it
+            with self._wd_suspend():
+                swapped, page_start, bytes_out = self._stage_swap_out(
+                    slot, rid, length)
         self._parked_state[rid] = _ParkedState(
             request=req, generated=generated, cur_tok=cur, gen_count=gen,
             rng_key=key, length=length, limit=limit, swapped=swapped,
@@ -1701,6 +1750,30 @@ class Engine:
             tr.event("req/preempt", cat="request", rid=rid,
                      swapped=swapped, generated=generated,
                      swap_bytes=bytes_out, **self._obs_args)
+
+    def _wd_suspend(self):
+        """Suspend the attached server watchdog (no-op context when none)
+        across planned long operations: a reconfiguration's preempt-all +
+        rebuild, or one victim's swap-out inside a preemption burst —
+        the stall detector must never read planned maintenance as a
+        wedged dispatch."""
+        wd = self.watchdog
+        return wd.suspend() if wd is not None else contextlib.nullcontext()
+
+    def reconfigure(self, spec):
+        """Apply a live reconfiguration between ticks: quiesce admissions
+        (structured ``reconfiguring`` stall label), preempt every running
+        slot through the park path, rebuild at the new shape, and let the
+        parked requests resume token-for-token on subsequent ticks. See
+        :mod:`gradaccum_tpu.serving.reconfig` for the spec helpers
+        (``pool_resize`` / ``checkpoint_swap``) and the refusal/degrade
+        contract. NOT thread-safe (like every Engine method): with a
+        ServingServer attached use ``server.request_reconfig(spec)``,
+        which runs this on the loop thread under the engine lock with the
+        watchdog and sentinel leases suspended."""
+        from gradaccum_tpu.serving import reconfig as reconfig_lib
+
+        return reconfig_lib.apply(self, spec)
 
     def preempt(self, request_id: int) -> bool:
         """Forcibly preempt a RUNNING request (park it for re-admission).

@@ -137,6 +137,15 @@ class ServingMetrics:
         self._c_reprefill = reg.counter("serving/resume_reprefills_total",
                                         labels=self._labels)
         self._preempt_window = LatencySeries(window=64)  # preempts/tick
+        # live reconfiguration plane: per-kind counters plus the bounded
+        # swap store's live footprint (the gauge a preemption storm's
+        # host-memory bill shows up on)
+        self.reconfigs: Dict[str, int] = {}  # kind -> count
+        self.reconfig_failures = 0           # degraded (ok=False) applies
+        self.reconfig_preempted = 0          # slots parked by reconfigs
+        self.swap_store_bytes = 0            # last sampled held_bytes
+        self._g_swap_store = reg.gauge("serving/swap_store_bytes",
+                                       labels=self._labels)
 
     # -- per-request lifecycle -------------------------------------------
 
@@ -227,10 +236,24 @@ class ServingMetrics:
             self._c_reprefill.inc()
 
     def record_swap_fallback(self) -> None:
-        """A swap record was abandoned (IO error, sha mismatch, or its
-        shared head died) — the request resumes by re-prefill instead.
-        Swap is an optimization; this counter is its failure bill."""
+        """A swap record was abandoned (IO error, sha mismatch, capacity
+        eviction, or its shared head died) — the request resumes by
+        re-prefill instead. Swap is an optimization; this counter is its
+        failure bill."""
         self.swap_fallbacks += 1
+
+    def record_reconfig(self, kind: str, ok: bool = True,
+                        preempted: int = 0) -> None:
+        """One live reconfiguration applied (or, ``ok=False``, degraded —
+        a rejected checkpoint kept the old state serving). Counted per
+        kind so /metrics shows resizes next to checkpoint swaps."""
+        self.reconfigs[kind] = self.reconfigs.get(kind, 0) + 1
+        self.reconfig_preempted += int(preempted)
+        if not ok:
+            self.reconfig_failures += 1
+        labels = {"kind": kind, **(self._labels or {})}
+        self.registry.counter("serving/reconfigs_total", labels=labels,
+                              help="live reconfigurations applied").inc()
 
     def recent_preemption_rate(self) -> Optional[float]:
         """Mean preemptions/tick over the last 64 ticks — the sentinel's
@@ -286,7 +309,8 @@ class ServingMetrics:
                     decode_block: Optional[int] = None,
                     shared_blocks: Optional[int] = None,
                     parked: Optional[int] = None,
-                    preemptions: Optional[int] = None) -> None:
+                    preemptions: Optional[int] = None,
+                    swap_store_bytes: Optional[int] = None) -> None:
         self.ticks += 1
         self.queue_depth.add(queue_depth)
         self.occupancy.add(active_slots / num_slots)
@@ -331,6 +355,10 @@ class ServingMetrics:
             # zero ticks count too: the windowed RATE must decay once a
             # storm passes, or the sentinel could never resolve it
             self._preempt_window.add(preemptions)
+        if swap_store_bytes is not None:
+            self.swap_store_bytes = int(swap_store_bytes)
+            self._g_swap_store.set(float(swap_store_bytes))
+            scalars["serving/swap_store_bytes"] = float(swap_store_bytes)
         # one call: records every scalar as a registry gauge AND streams to
         # the EventWriter when one is attached (replica-labeled in a fleet)
         self.registry.publish(scalars, step=self.ticks, labels=self._labels)
@@ -385,7 +413,11 @@ class ServingMetrics:
             "swap_fallbacks": self.swap_fallbacks,
             "swap_bytes_out": self.swap_bytes_out,
             "swap_bytes_in": self.swap_bytes_in,
+            "swap_store_bytes": self.swap_store_bytes,
             "parked_peak": self.parked_peak,
+            "reconfigs": dict(self.reconfigs),
+            "reconfig_failures": self.reconfig_failures,
+            "reconfig_preempted": self.reconfig_preempted,
             "tokens_emitted": self.tokens_emitted,
             "tokens_per_second": self.tokens_per_second(),
             "ticks": self.ticks,

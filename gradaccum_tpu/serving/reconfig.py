@@ -1,0 +1,421 @@
+"""Live engine reconfiguration: the serving analogue of crash-resume.
+
+Training survives interruption at any step boundary bitwise-identically
+because the accumulate/apply contract makes every boundary a clean cut
+point; this module gives the serving stack the same guarantee for
+*planned* interruption. A reconfiguration is a controlled preemption of
+the whole engine: quiesce admissions (fresh traffic waits behind a
+structured ``reconfiguring`` stall label), preempt every running slot
+through the PR-12 preempt→park lifecycle (K/V staged to the
+:class:`~gradaccum_tpu.serving.swap.HostSwapStore`, or dropped for
+re-prefill resume), rebuild whatever the spec changes at the new shape,
+then let the parked requests resume token-for-token identical — the same
+resume machinery pool pressure already exercises, so reconfiguration adds
+no second recovery path.
+
+Three reconfiguration kinds ship behind one :class:`ReconfigSpec`:
+
+- **pool resize** (:func:`pool_resize`) — grow or shrink a paged engine's
+  ``num_blocks``. Shrinking below live + parked demand refuses with a
+  structured :class:`ReconfigError` (``demand``/``supply`` fields): every
+  in-flight request must still be able to run to completion at the new
+  size. The rebuilt page table goes through the pool's existing
+  upload-time :class:`~gradaccum_tpu.serving.cache_pool.
+  BlockTableCorruption` bounds check before the reconfig is declared done.
+- **checkpoint swap** (:func:`checkpoint_swap`) — load new params from a
+  sha256-manifested checkpoint (``estimator/checkpoint.py``'s
+  quarantine-and-fallback restore) or an in-memory pytree, re-applying
+  mesh placement via the same ``shard_params`` path ``recover()`` uses. A
+  poisoned/corrupt checkpoint degrades to quarantine-and-keep-serving
+  (the PR-2 fallback contract): the result reports ``ok=False`` and the
+  old weights keep serving. When the new weights are byte-identical to
+  the old (a config-only redeploy), swapped K/V stays valid and resumed
+  streams are token-for-token identical to an unreconfigured run — the
+  parity gate in tests/test_serving_reconfig.py. When weights actually
+  change, host swap records are discarded and every parked request
+  resumes by re-prefill, so no stream ever decodes new weights against
+  old K/V (the prefix cache is cleared for the same reason).
+- **replica scale** (:func:`replica_drain` / :func:`replica_activate`) —
+  drain one replica of a :class:`~gradaccum_tpu.serving.replicated.
+  ReplicatedEngine` through the same preempt/park path while its siblings
+  keep serving, re-dispatching the displaced work across the fleet;
+  activating brings a drained replica back into the candidate order. The
+  fleet is provisioned at construction — scaling moves replicas in and
+  out of ACTIVE service (the id lattice and routing stay intact), it does
+  not mint new engines.
+
+The crash point ``resilience/faults.py::MID_RECONFIG`` fires twice per
+reconfiguration — index ``2n`` after the preempt (old config, everything
+parked) and ``2n+1`` after the rebuild (new config, everything parked) —
+so a kill mid-rebuild lands in one of two CLEAN states, never a torn
+pool: either way every request is parked with its resume snapshot and the
+next ticks drain it through the ordinary resume path.
+
+Fleet-wide coordination: a multi-host deployment agrees the reconfig tick
+through the same :class:`~gradaccum_tpu.resilience.preemption.
+DrainConsensus` control-plane exchange a drain uses (:func:`agree_tick`
+— any-requested, max-tick), and per-HOST liveness leases on the consensus
+transport let survivors distinguish a slow host from a gone one instead
+of waiting out the barrier timeout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from gradaccum_tpu.resilience import faults
+from gradaccum_tpu.serving.cache_pool import PagedCachePool
+
+POOL_RESIZE = "pool_resize"
+CHECKPOINT_SWAP = "checkpoint_swap"
+REPLICA_SCALE = "replica_scale"
+KINDS = (POOL_RESIZE, CHECKPOINT_SWAP, REPLICA_SCALE)
+
+
+class ReconfigError(RuntimeError):
+    """A reconfiguration spec the engine REFUSES (nothing was changed):
+    shrinking below live demand, resizing a fixed pool, a replica index
+    out of range. Distinct from a checkpoint-swap rejection, which is a
+    degradation (``ReconfigResult.ok=False``, old weights keep serving)
+    rather than a refusal — a bad spec is the operator's bug, a bad
+    checkpoint is the environment's."""
+
+    def __init__(self, message: str, demand: Optional[int] = None,
+                 supply: Optional[int] = None):
+        super().__init__(message)
+        self.demand = demand
+        self.supply = supply
+
+
+@dataclasses.dataclass(frozen=True)
+class ReconfigSpec:
+    """One reconfiguration order. Build via the helpers
+    (:func:`pool_resize`, :func:`checkpoint_swap`, :func:`replica_drain`,
+    :func:`replica_activate`) rather than by hand — they keep the
+    kind/field pairing honest."""
+
+    kind: str
+    num_blocks: Optional[int] = None     # pool_resize
+    checkpoint: Optional[str] = None     # checkpoint_swap: file or dir
+    params: Any = None                   # checkpoint_swap: in-memory pytree
+    draft_params: Any = None             # checkpoint_swap: optional new draft
+    replica: Optional[int] = None        # replica_scale target
+    action: Optional[str] = None         # replica_scale: "drain"|"activate"
+    # internal: a fleet fan-out computes the weights-unchanged verdict
+    # ONCE and passes it down, so N replicas don't re-hash the same
+    # params 2N times under their engine locks
+    unchanged_hint: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown reconfig kind {self.kind!r}; "
+                             f"one of {KINDS}")
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "num_blocks": self.num_blocks,
+                "checkpoint": self.checkpoint, "replica": self.replica,
+                "action": self.action,
+                "inline_params": self.params is not None}
+
+
+def pool_resize(num_blocks: int) -> ReconfigSpec:
+    """Grow/shrink a paged engine's block pool to ``num_blocks``."""
+    if int(num_blocks) < 1:
+        raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+    return ReconfigSpec(POOL_RESIZE, num_blocks=int(num_blocks))
+
+
+def checkpoint_swap(checkpoint: Optional[str] = None, params: Any = None,
+                    draft_params: Any = None) -> ReconfigSpec:
+    """Swap serving weights: from a sha256-manifested checkpoint path
+    (file or directory — directory restore quarantines corrupt candidates
+    and falls back, exactly like training resume) or an in-memory pytree.
+    ``draft_params`` optionally refreshes a speculative engine's draft;
+    omitted, the old draft keeps proposing — stale drafts cost accept
+    rate, never correctness (the accept rule only ever emits what the
+    TARGET scores)."""
+    if (checkpoint is None) == (params is None):
+        raise ValueError("checkpoint_swap needs exactly one of "
+                         "checkpoint= (a path) or params= (a pytree)")
+    return ReconfigSpec(CHECKPOINT_SWAP, checkpoint=checkpoint,
+                        params=params, draft_params=draft_params)
+
+
+def replica_drain(replica: int) -> ReconfigSpec:
+    """Take one replica out of service: its running work is preempted
+    through the park path, its queued+parked requests are re-dispatched
+    across the siblings, and dispatch stops routing to it."""
+    return ReconfigSpec(REPLICA_SCALE, replica=int(replica), action="drain")
+
+
+def replica_activate(replica: int) -> ReconfigSpec:
+    """Bring a drained replica back into the dispatch candidate order
+    (its pool is empty — it rejoins cold, exactly like a fresh engine)."""
+    return ReconfigSpec(REPLICA_SCALE, replica=int(replica),
+                        action="activate")
+
+
+@dataclasses.dataclass
+class ReconfigResult:
+    """What one reconfiguration did. ``ok=False`` means the engine
+    DEGRADED instead of applying (corrupt checkpoint quarantined, old
+    state kept serving) — a refused spec raises :class:`ReconfigError`
+    instead and produces no result."""
+
+    kind: str
+    ok: bool
+    reason: Optional[str] = None
+    preempted: int = 0
+    tick: int = 0
+    detail: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "ok": self.ok, "reason": self.reason,
+                "preempted": self.preempted, "tick": self.tick,
+                "detail": dict(self.detail)}
+
+
+def params_digest(params) -> str:
+    """sha256 over every leaf's dtype/shape/bytes — the cheap "did the
+    weights actually change" test that gates whether swapped K/V may be
+    restored (identical weights ⇒ identical K/V) or must be recomputed."""
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(params):
+        arr = np.asarray(jax.device_get(leaf))
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def agree_tick(consensus, requested: bool, tick: int):
+    """Fleet-wide reconfig scheduling over the drain-consensus transport:
+    every host calls this at the same cadence with (do I want a reconfig,
+    my current tick) and receives the identical (any host wants one, max
+    tick) decision — the agreed tick to reconfigure at. Use a dedicated
+    :class:`~gradaccum_tpu.resilience.preemption.DrainConsensus` (own
+    ``key_prefix`` / bus) so reconfig rounds never interleave with drain
+    rounds."""
+    return consensus.decide(bool(requested), int(tick))
+
+
+# -- the engine-level application --------------------------------------------
+
+
+def _quiesce(engine) -> None:
+    """Admissions are held for the duration of the reconfiguration; the
+    structured stall label tells operators WHY fresh traffic is waiting
+    (next to PR-12's "held_by_quantile_gate")."""
+    if engine.scheduler.depth:
+        engine.scheduler.record_stall("reconfiguring")
+
+
+def _preempt_all(engine, keep_swap: bool = True) -> int:
+    """Every running slot through the ordinary preempt→park path. With
+    ``keep_swap=False`` (weights changed: old K/V must never re-enter
+    the pool) the victims park WITHOUT staging device→host copies at
+    all, and any records PREVIOUSLY parked requests hold are discarded
+    — every parked request then resumes by re-prefill."""
+    preempted = []
+    for slot, req in enumerate(engine._slot_req):
+        if req is not None and engine._active[slot]:
+            engine._preempt(slot, preempted, stage_swap=keep_swap)
+    if not keep_swap and engine._swap_store is not None:
+        for rid, pk in engine._parked_state.items():
+            if pk.swapped:
+                engine._swap_store.discard(rid)
+                pk.swapped = False
+    return len(preempted)
+
+
+def _demand_blocks(engine) -> int:
+    """The largest single in-flight request's worst-case block need —
+    the shrink floor. Parked requests resume strict FIFO (one at a time
+    against an otherwise-drainable pool), so the binding constraint is
+    the biggest reservation any one of them will ask for, not the sum."""
+    pool = engine.pool
+    need = 0
+    for slot, req in enumerate(engine._slot_req):
+        if req is not None:
+            limit = int(engine._slot_limit[slot]) or (
+                req.prompt.size + req.max_new_tokens)
+            need = max(need, pool.blocks_for(limit))
+    for pk in engine._parked_state.values():
+        need = max(need, pool.blocks_for(pk.limit))
+    for r in engine.scheduler.pending():
+        need = max(need, pool.blocks_for(r.prompt.size + r.max_new_tokens))
+    return need
+
+
+def validate_pool_resize(engine, spec: ReconfigSpec) -> None:
+    """Every refusal a pool resize can raise, with NOTHING mutated — so
+    a fleet fan-out can pre-check every replica before any of them
+    rebuilds (a mid-loop refusal must never tear the fleet into mixed
+    block counts)."""
+    if not engine.paged:
+        raise ReconfigError(
+            "pool_resize needs paged mode (the fixed pool's shape is "
+            "num_slots x max_len — there is no block count to resize)"
+        )
+    nb = int(spec.num_blocks)
+    if engine.mesh is not None:
+        from gradaccum_tpu.parallel.mesh import MODEL_AXIS
+
+        tp = int(engine.mesh.shape[MODEL_AXIS])
+        if nb % tp:
+            raise ReconfigError(
+                f"num_blocks {nb} not divisible by the model axis ({tp}) "
+                "— the paged pool shards its BLOCK axis"
+            )
+    demand = _demand_blocks(engine)
+    if nb < demand:
+        raise ReconfigError(
+            f"cannot shrink to {nb} blocks: live+parked demand needs "
+            f"{demand} (the largest in-flight request's worst case must "
+            "still fit, or it could never resume)",
+            demand=demand, supply=nb,
+        )
+
+
+def _pool_resize(engine, spec: ReconfigSpec) -> ReconfigResult:
+    validate_pool_resize(engine, spec)
+    nb = int(spec.num_blocks)
+    _quiesce(engine)
+    preempted = _preempt_all(engine)
+    # crash point A: old config, everything parked — a kill here resumes
+    # on the OLD pool shape through the ordinary park machinery
+    faults.fire(faults.MID_RECONFIG, 2 * engine._reconfig_count)
+    old_nb = engine.num_blocks
+    if engine.prefix_cache is not None:
+        # every old block is about to vanish; releases already forgot
+        # their entries, but clear defensively — no stale hash may
+        # outlive the rebuild
+        engine.prefix_cache.clear()
+    pool = PagedCachePool(engine.cfg, engine.pool.num_slots, engine.max_len,
+                          engine.page_size, nb,
+                          prefix_cache=engine.prefix_cache,
+                          cache_dtype=engine.cache_dtype)
+    if (engine.admission_policy is not None
+            and engine.admission_policy.mode != "reserve"):
+        pool.allow_overcommit = True
+    engine.pool = pool
+    engine.num_blocks = nb
+    engine._slot_len[:] = 0
+    engine._slot_limit[:] = 0
+    if engine.mesh is not None:
+        engine._apply_mesh()
+    # the rebuilt table through the SAME upload-time bounds check every
+    # tick uses — a torn rebuild must fault structured here, not gather
+    # garbage blocks into some resumed request's attention
+    pool.page_table_device()
+    # crash point B: new config, everything parked — the rebuild is
+    # complete before this fires, so a kill lands on a clean NEW pool
+    faults.fire(faults.MID_RECONFIG, 2 * engine._reconfig_count + 1)
+    return ReconfigResult(
+        POOL_RESIZE, ok=True, preempted=preempted, tick=engine._tick,
+        detail={"old_num_blocks": old_nb, "new_num_blocks": nb},
+    )
+
+
+def _checkpoint_swap(engine, spec: ReconfigSpec) -> ReconfigResult:
+    if spec.params is not None:
+        new_params = spec.params
+    else:
+        from gradaccum_tpu.estimator import checkpoint as ckpt_lib
+
+        template = jax.device_get(engine.params)
+        try:
+            new_params = ckpt_lib.restore(spec.checkpoint, template)
+        except (ckpt_lib.CheckpointCorruptError, FileNotFoundError,
+                OSError, ValueError) as e:
+            # the PR-2 fallback contract: a poisoned checkpoint is
+            # quarantined (restore already renamed proven-corrupt files)
+            # and the OLD weights keep serving — a bad artifact must
+            # never take the fleet down
+            return ReconfigResult(
+                CHECKPOINT_SWAP, ok=False,
+                reason=f"checkpoint rejected: {e}",
+                tick=engine._tick,
+                detail={"checkpoint": spec.checkpoint, "quarantined": True},
+            )
+    if spec.unchanged_hint is not None:
+        unchanged = bool(spec.unchanged_hint)
+    else:
+        unchanged = params_digest(engine.params) == params_digest(new_params)
+    _quiesce(engine)
+    # unchanged weights keep their swapped K/V bitwise-valid; changed
+    # weights force re-prefill resumes — no stream may decode new weights
+    # against K/V the old weights produced
+    preempted = _preempt_all(engine, keep_swap=unchanged)
+    faults.fire(faults.MID_RECONFIG, 2 * engine._reconfig_count)
+    if engine.mesh is not None:
+        from gradaccum_tpu.parallel.sharding import shard_params
+        from gradaccum_tpu.parallel.tp import gpt_tp_rules
+
+        new_params = shard_params(new_params, engine.mesh, gpt_tp_rules())
+    engine.params = new_params
+    draft_refreshed = False
+    if spec.draft_params is not None and engine.speculate_k:
+        draft = spec.draft_params
+        if engine.mesh is not None:
+            from gradaccum_tpu.parallel.sharding import shard_params
+            from gradaccum_tpu.parallel.tp import gpt_tp_rules
+
+            draft = shard_params(draft, engine.mesh, gpt_tp_rules())
+        engine.draft_params = draft
+        draft_refreshed = True
+    if not unchanged and engine.prefix_cache is not None:
+        # shared-prefix entries index K/V the OLD weights computed
+        engine.prefix_cache.clear()
+    faults.fire(faults.MID_RECONFIG, 2 * engine._reconfig_count + 1)
+    return ReconfigResult(
+        CHECKPOINT_SWAP, ok=True, preempted=preempted, tick=engine._tick,
+        detail={"weights_unchanged": unchanged,
+                "checkpoint": spec.checkpoint,
+                "draft_refreshed": draft_refreshed},
+    )
+
+
+def apply(engine, spec: ReconfigSpec) -> ReconfigResult:
+    """Apply ``spec`` to one :class:`~gradaccum_tpu.serving.engine.
+    Engine` between ticks (callers hold whatever lock serializes
+    ``step()``; :meth:`ServingServer.request_reconfig` runs this on the
+    loop thread). Raises :class:`ReconfigError` for refused specs (state
+    untouched); returns ``ok=False`` for degraded checkpoint swaps; on a
+    crash-point kill the engine is left in a clean old-or-new config with
+    everything parked, and the exception propagates for the server's
+    fault contract to log."""
+    if spec.kind == REPLICA_SCALE:
+        raise ReconfigError(
+            "replica_scale is a fleet operation — apply it through "
+            "ReplicatedEngine.reconfigure or "
+            "ServingServer.request_reconfig"
+        )
+    tr = engine.tracer
+    tick0 = engine._tick
+    engine.reconfiguring = True
+    try:
+        with engine._wd_suspend():
+            if spec.kind == POOL_RESIZE:
+                result = _pool_resize(engine, spec)
+            else:
+                result = _checkpoint_swap(engine, spec)
+    finally:
+        engine.reconfiguring = False
+        # count advances even through a crash-point kill, so a retried
+        # reconfiguration fires fresh fault indices instead of replaying
+        # the consumed ones
+        engine._reconfig_count += 1
+    engine.last_reconfig = result
+    engine.metrics.record_reconfig(result.kind, ok=result.ok,
+                                   preempted=result.preempted)
+    if tr.enabled:
+        tr.event("serve/reconfig", cat="serving", kind=spec.kind,
+                 ok=result.ok, preempted=result.preempted, tick=tick0,
+                 **engine._obs_args)
+    return result

@@ -149,6 +149,8 @@ class _FleetMetrics:
             "preemptions": sum(p["preemptions"] for p in per),
             "swap_bytes_out": sum(p["swap_bytes_out"] for p in per),
             "swap_bytes_in": sum(p["swap_bytes_in"] for p in per),
+            "swap_store_bytes": sum(p["swap_store_bytes"] for p in per),
+            "reconfigs": _sum_dicts(p["reconfigs"] for p in per),
             "per_replica": per,
         }
 
@@ -251,6 +253,11 @@ class ReplicatedEngine:
         self.status = _FleetDict(self.replicas, "status")
         self._tick = 0
         self._faulted: Set[int] = set()
+        # replicas taken out of service by a replica_scale reconfiguration
+        # (drained: no dispatch, no ticks; the engine object and its slice
+        # of the id lattice stay provisioned so activation is instant and
+        # rid % N routing never changes)
+        self._inactive: Set[int] = set()
         # healthy replicas' events from a partially-faulted tick, delivered
         # with the next clean tick (see step())
         self._held: List[StepEvents] = []
@@ -327,16 +334,24 @@ class ReplicatedEngine:
     # -- request intake ----------------------------------------------------
 
     def _candidates(self, prompt: np.ndarray) -> List[int]:
-        """Replica indices in dispatch order: longest live prefix match
-        first (affinity — the blocks are THERE, a different replica would
-        cold-miss), then least loaded, then lowest index (determinism)."""
+        """ACTIVE replica indices in dispatch order: longest live prefix
+        match first (affinity — the blocks are THERE, a different replica
+        would cold-miss), then least loaded, then lowest index
+        (determinism). Drained replicas are out of the order entirely."""
         keys = []
         for i, e in enumerate(self.replicas):
+            if i in self._inactive:
+                continue
             shared = 0
             if e.prefix_cache is not None and prompt.size > e.page_size:
                 shared = len(e.prefix_cache.match(prompt))
             load = e.scheduler.depth + e.pool.active_count
             keys.append((-shared, load, i))
+        if not keys:
+            raise RuntimeError(
+                "every replica is drained — activate one "
+                "(reconfig.replica_activate) before submitting"
+            )
         return [i for _, _, i in sorted(keys)]
 
     def submit(self, prompt, max_new_tokens: int,
@@ -376,23 +391,32 @@ class ReplicatedEngine:
         already reconciled away."""
         t = self._tick
         snt = self.sentinel
+        # drained replicas sit ticks out entirely: no work can reach them
+        # and a parked lease on an intentionally idle engine must not
+        # masquerade as a heartbeat
+        active = [i for i in range(len(self.replicas))
+                  if i not in self._inactive]
         if self._pool is None:
-            evs = [self.replicas[0].step()]
-            if snt is not None:
-                snt.heartbeat(replica=0, tick=self.replicas[0].tick_count,
-                              busy=not self.replicas[0].idle)
+            evs = []
+            for i in active:
+                evs.append(self.replicas[i].step())
+                if snt is not None:
+                    snt.heartbeat(replica=i,
+                                  tick=self.replicas[i].tick_count,
+                                  busy=not self.replicas[i].idle)
         else:
             tr = self.tracer
             if tr.enabled and getattr(tr, "deterministic", False):
                 # a deterministic tracer promises byte-identical event
                 # order across seeded runs; racing replica threads into
                 # the one shared ring would break it — tick sequentially
-                waits = [e.step for e in self.replicas]
+                waits = [(i, self.replicas[i].step) for i in active]
             else:
-                futures = [self._pool.submit(e.step) for e in self.replicas]
-                waits = [f.result for f in futures]
+                futures = [(i, self._pool.submit(self.replicas[i].step))
+                           for i in active]
+                waits = [(i, f.result) for i, f in futures]
             evs, errors = [], []
-            for i, w in enumerate(waits):
+            for i, w in waits:
                 try:
                     evs.append(w())
                     if snt is not None:
@@ -450,6 +474,188 @@ class ReplicatedEngine:
         for i in targets:
             failed.extend(self.replicas[i].recover())
         return failed
+
+    # -- live reconfiguration (replica scale + fleet-wide fan-out) ---------
+
+    @property
+    def active_replicas(self) -> List[int]:
+        """Replica indices currently in service (dispatch candidates)."""
+        return [i for i in range(len(self.replicas))
+                if i not in self._inactive]
+
+    def _check_replica(self, replica) -> int:
+        if replica is None or not 0 <= int(replica) < len(self.replicas):
+            raise ValueError(
+                f"replica must be in [0, {len(self.replicas)}), "
+                f"got {replica}"
+            )
+        return int(replica)
+
+    def drain_replica(self, replica: int):
+        """Take one replica out of service while its siblings keep
+        serving: dispatch stops routing to it FIRST, its running slots go
+        through the same preempt→park path pool pressure uses, and every
+        displaced request (parked work oldest-first, then the fresh
+        queue) is returned with its original prompt/budget/seed for
+        re-dispatch across the fleet. Partial results are discarded — a
+        displaced request replays from scratch on its new home, exactly
+        the fault-requeue contract (greedy replay is token-identical;
+        streaming consumers may observe a duplicated prefix). Displaced
+        requests re-enter queue-waiting, so their queue DEADLINES apply
+        again — the same rule the parked-expiry contract already sets
+        for preempted requests (``Scheduler.expire``). NOT thread-safe;
+        a ServingServer runs this under the replica's lock via
+        ``request_reconfig``."""
+        replica = self._check_replica(replica)
+        e = self.replicas[replica]
+        self._inactive.add(replica)  # no new work routes here from now on
+        preempted: List[int] = []
+        for slot, req in enumerate(e._slot_req):
+            if req is not None and e._active[slot]:
+                # the park is consumed immediately below (the request
+                # replays from scratch on a sibling) — staging its K/V
+                # to the host store would be a wasted device->host copy
+                e._preempt(slot, preempted, stage_swap=False)
+        displaced: List[Request] = []
+        while e.scheduler.parked_depth:
+            req = e.scheduler.pop_parked()
+            rid = req.request_id
+            e._parked_state.pop(rid, None)
+            if e._swap_store is not None:
+                e._swap_store.discard(rid)
+            e.results.pop(rid, None)
+            e.status.pop(rid, None)
+            displaced.append(req)
+        for req in e.scheduler.drain_queue():
+            e.results.pop(req.request_id, None)
+            e.status.pop(req.request_id, None)
+            displaced.append(req)
+        if self.sentinel is not None:
+            # the drained replica stops ticking ON PURPOSE: park its
+            # heartbeat lease, or the planned silence fires a false
+            # dead_replica (and a spurious recover remediation) one
+            # lease interval later
+            self.sentinel.heartbeat(replica=replica, tick=e.tick_count,
+                                    busy=False)
+        return displaced
+
+    def activate_replica(self, replica: int) -> None:
+        """Return a drained replica to the dispatch candidate order (it
+        rejoins with an empty pool, like a fresh engine)."""
+        self._inactive.discard(self._check_replica(replica))
+
+    def reconfigure(self, spec, resubmit: bool = True):
+        """Fleet-wide live reconfiguration. ``pool_resize`` and
+        ``checkpoint_swap`` fan out to every ACTIVE replica (a
+        path-based checkpoint is restored ONCE and distributed in
+        memory, so N replicas cost one disk read and one quarantine
+        decision); ``replica_scale`` drains or activates one replica,
+        re-dispatching a drained replica's displaced work across its
+        siblings (``resubmit=False`` hands the displaced requests back
+        in ``result.detail["displaced"]`` instead — the ServingServer
+        path, which must rebind stream handles itself).
+
+        Atomicity: every REFUSAL (shrink below demand, divisibility) is
+        pre-checked across all active replicas before any of them
+        mutates, so a refused fleet resize genuinely changes nothing. A
+        crash-point KILL mid-fan-out can still leave replicas at
+        different configurations — each individually clean (old-or-new,
+        everything parked) — and retrying the same spec converges the
+        stragglers."""
+        import dataclasses as _dc
+
+        from gradaccum_tpu.serving import reconfig as reconfig_lib
+
+        tr = self.tracer
+        if spec.kind == reconfig_lib.REPLICA_SCALE:
+            replica = self._check_replica(spec.replica)
+            e = self.replicas[replica]
+            if spec.action == "activate":
+                self.activate_replica(replica)
+                result = reconfig_lib.ReconfigResult(
+                    spec.kind, ok=True, tick=self._tick,
+                    detail={"replica": replica, "action": "activate",
+                            "active_replicas": self.active_replicas},
+                )
+            else:
+                src_tick = e.tick_count
+                displaced = self.drain_replica(replica)
+                moved: Dict[int, int] = {}
+                failed: List[int] = []
+                if resubmit:
+                    for req in displaced:
+                        remaining = (None if req.deadline_tick is None
+                                     else max(0, req.deadline_tick
+                                              - src_tick))
+                        try:
+                            moved[req.request_id] = self.submit(
+                                req.prompt, req.max_new_tokens,
+                                eos_id=req.eos_id, rng_seed=req.rng_seed,
+                                deadline_ticks=remaining,
+                            )
+                        except Exception:  # noqa: BLE001 — QueueFull etc.
+                            failed.append(req.request_id)
+                result = reconfig_lib.ReconfigResult(
+                    spec.kind, ok=not failed,
+                    reason=(None if not failed
+                            else f"{len(failed)} displaced request(s) "
+                                 "found no sibling capacity"),
+                    preempted=len(displaced), tick=self._tick,
+                    detail={"replica": replica, "action": "drain",
+                            "active_replicas": self.active_replicas,
+                            "resubmitted": moved, "failed": failed,
+                            **({} if resubmit
+                               else {"displaced": displaced})},
+                )
+            e.metrics.record_reconfig(spec.kind, ok=result.ok,
+                                      preempted=result.preempted)
+            if tr.enabled:
+                tr.event("serve/reconfig", cat="serving", kind=spec.kind,
+                         ok=result.ok, replica=replica,
+                         action=spec.action, **self.obs_tags())
+            return result
+        if (spec.kind == reconfig_lib.CHECKPOINT_SWAP
+                and spec.checkpoint is not None):
+            from gradaccum_tpu.estimator import checkpoint as ckpt_lib
+
+            template = jax.device_get(self.replicas[0].params)
+            try:
+                new_params = ckpt_lib.restore(spec.checkpoint, template)
+            except (ckpt_lib.CheckpointCorruptError, FileNotFoundError,
+                    OSError, ValueError) as exc:
+                # one quarantine decision for the whole fleet: every
+                # replica keeps serving the old weights
+                return reconfig_lib.ReconfigResult(
+                    spec.kind, ok=False,
+                    reason=f"checkpoint rejected: {exc}", tick=self._tick,
+                    detail={"checkpoint": spec.checkpoint,
+                            "quarantined": True},
+                )
+            spec = reconfig_lib.checkpoint_swap(
+                params=new_params, draft_params=spec.draft_params)
+        if spec.kind == reconfig_lib.POOL_RESIZE:
+            # refuse BEFORE any replica mutates: a mid-loop refusal
+            # (one replica's demand above the new size) must never tear
+            # the fleet into mixed block counts
+            for i in self.active_replicas:
+                reconfig_lib.validate_pool_resize(self.replicas[i], spec)
+        elif (spec.kind == reconfig_lib.CHECKPOINT_SWAP
+                and spec.unchanged_hint is None):
+            # hash the weights ONCE for the whole fleet (replicas carry
+            # identical params) instead of 2 digests per replica under
+            # quiesced traffic
+            spec = _dc.replace(spec, unchanged_hint=(
+                reconfig_lib.params_digest(self.replicas[0].params)
+                == reconfig_lib.params_digest(spec.params)))
+        per = [self.replicas[i].reconfigure(spec)
+               for i in self.active_replicas]
+        ok = all(r.ok for r in per)
+        return reconfig_lib.ReconfigResult(
+            spec.kind, ok=ok,
+            reason=None if ok else next(r.reason for r in per if not r.ok),
+            preempted=sum(r.preempted for r in per), tick=self._tick,
+            detail={"per_replica": [r.to_dict() for r in per]},
+        )
 
     def drain(self, max_ticks: int = 100_000) -> None:
         """Free-run every replica to idle CONCURRENTLY — each replica

@@ -93,7 +93,9 @@ class Scheduler:
         # "no_free_blocks" tells an operator which resource to grow;
         # admission-policy engines add "held_by_quantile_gate" (blocks
         # exist but the policy's budget gate refused) and
-        # "parked_queue_ahead" (preempted requests resume first). A
+        # "parked_queue_ahead" (preempted requests resume first);
+        # a live reconfiguration records "reconfiguring" while fresh
+        # traffic waits out the quiesce. A
         # replica engine sets ``label`` ("replica 2") so fleet-level stall
         # keys also say WHICH engine is saturated; None keeps the
         # single-engine keys exactly as they always were.
@@ -134,6 +136,21 @@ class Scheduler:
     def peek(self) -> Optional[Request]:
         """The request next in line for admission (None when empty)."""
         return self._queue[0] if self._queue else None
+
+    def pending(self) -> List[Request]:
+        """A copy of the fresh queue in admission order — reconfiguration
+        sizes its shrink-refusal demand from it without reaching into the
+        deque."""
+        return list(self._queue)
+
+    def drain_queue(self) -> List[Request]:
+        """Pop EVERY queued request (admission order) — the replica-drain
+        path re-dispatches them across sibling replicas. Parked requests
+        are popped through the usual ``pop_parked`` so the engine can
+        clean their resume state alongside."""
+        out = list(self._queue)
+        self._queue.clear()
+        return out
 
     # -- the parked (preemption) queue ------------------------------------
 

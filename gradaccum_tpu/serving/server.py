@@ -28,10 +28,13 @@ the per-request and the server lifecycle level.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import queue
 import threading
 import time
+from collections import deque
+from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -191,6 +194,16 @@ class ServingServer:
     deterministic :class:`SimulationDriver` stays on lockstep ``step()``
     by construction — free-running is a server-only mode. Ignored (plain
     lockstep loop) for a single non-replicated engine.
+
+    **Live reconfiguration**: :meth:`request_reconfig` queues a
+    ``serving/reconfig.py`` spec (pool resize, checkpoint swap, replica
+    drain/activate) for the loop thread to apply under the engine lock —
+    in-flight streams are preempted to the host store and resume
+    token-for-token, fresh traffic waits out the quiesce behind a
+    ``reconfiguring`` stall label, and the watchdog + sentinel leases
+    are suspended so the planned rebuild can never read as a stall. A
+    drained replica's requests re-dispatch across the fleet with their
+    :class:`StreamHandle`\\ s rebound.
     """
 
     def __init__(
@@ -227,6 +240,10 @@ class ServingServer:
         # so a nudge aimed at a wedged replica can never block later
         # remediations for healthy ones
         self._nudges: Dict[Optional[int], str] = {}
+        # pending live reconfigurations (spec, Future) — executed on a
+        # loop thread (first poller claims the whole job) with the
+        # watchdog + sentinel leases suspended; guarded by _hlock
+        self._reconfigs: "deque" = deque()
         # a fleet engine forwards per-replica heartbeats itself; the
         # server only feeds engine-level signals for single engines
         if sentinel is not None and hasattr(engine, "replicas") \
@@ -285,6 +302,12 @@ class ServingServer:
             raise RuntimeError("server was stopped and cannot be restarted; "
                                "build a new ServingServer around the engine")
         if self._free_running:
+            if self._watchdogs is not None:
+                # pin each replica's watchdog on its engine so planned
+                # long operations (reconfig rebuilds, swap bursts) can
+                # suspend their own stall windows
+                for e, wd in zip(self._engine.replicas, self._watchdogs):
+                    e.watchdog = wd
             self._threads = [
                 threading.Thread(target=self._replica_loop, args=(i,),
                                  daemon=True, name=f"serving-replica-{i}")
@@ -296,6 +319,9 @@ class ServingServer:
                 for wd in self._watchdogs:
                     wd.start()
         else:
+            if self._watchdog is not None:
+                for e in getattr(self._engine, "replicas", [self._engine]):
+                    e.watchdog = self._watchdog
             self._thread = threading.Thread(target=self._loop, daemon=True,
                                             name="serving-engine")
             self._thread.start()
@@ -384,6 +410,170 @@ class ServingServer:
             if self._error is None and replica not in self._nudges:
                 self._nudges[replica] = reason
 
+    # -- live reconfiguration ---------------------------------------------
+
+    def request_reconfig(self, spec) -> "Future":
+        """Queue a live reconfiguration (``serving/reconfig.py`` spec:
+        pool resize, checkpoint swap, replica drain/activate) for the
+        loop thread to execute at its next iteration — under the engine
+        lock, with the tick watchdog and the sentinel's heartbeat leases
+        SUSPENDED so a multi-second planned rebuild can never read as a
+        stall or a dead replica. Safe from any thread. Returns a Future
+        resolving to the :class:`~gradaccum_tpu.serving.reconfig.
+        ReconfigResult` (or raising what the reconfiguration raised —
+        a refused spec's ``ReconfigError``, or a crash-point kill's
+        injected fault after the fault contract logged it). A drained
+        replica's displaced requests are re-dispatched across the fleet
+        with their stream handles rebound — callers keep their handles,
+        token-for-token (greedy) through the move."""
+        fut: Future = Future()
+        with self._hlock:
+            if self._error is not None:
+                raise RuntimeError(
+                    "serving engine thread died"
+                ) from self._error
+            self._reconfigs.append((spec, fut))
+        return fut
+
+    def reconfigure(self, spec, timeout: Optional[float] = 60.0):
+        """Blocking :meth:`request_reconfig` — returns the result once
+        the loop thread has applied it."""
+        return self.request_reconfig(spec).result(timeout)
+
+    @contextlib.contextmanager
+    def _maintenance(self):
+        """Planned-interruption shield around a reconfiguration: every
+        tick watchdog suspended (a rebuild is not a wedged dispatch) and
+        the sentinel's lease clock paused (loops stop heartbeating while
+        the engine lock is held for the rebuild — that silence is
+        planned)."""
+        wds = ([self._watchdog] if self._watchdog is not None else []) \
+            + list(self._watchdogs or [])
+        with contextlib.ExitStack() as stack:
+            for wd in wds:
+                stack.enter_context(wd.suspend())
+            if self._sentinel is not None:
+                stack.enter_context(self._sentinel.maintenance())
+            yield
+
+    @contextlib.contextmanager
+    def _engine_locked(self):
+        """The whole engine, whatever the loop mode: the lockstep lock,
+        or EVERY replica lock in index order (free-running loops each
+        hold at most their own, so ordered acquisition cannot
+        deadlock)."""
+        with contextlib.ExitStack() as stack:
+            if self._free_running:
+                for lk in self._rlocks:
+                    stack.enter_context(lk)
+            else:
+                stack.enter_context(self._lock)
+            yield
+
+    def _execute_reconfig(self, spec, fut: "Future") -> None:
+        """Run one queued reconfiguration on a loop thread. A crash-point
+        kill routes through the PROVEN fault contract (recover → flight
+        dump) and then fails the future; the engine is left in a clean
+        old-or-new configuration with the displaced work parked."""
+        from gradaccum_tpu.serving import reconfig as reconfig_lib
+
+        eng = self._engine
+        fleet = hasattr(eng, "replicas")
+        try:
+            with self._maintenance():
+                if (fleet and spec.kind == reconfig_lib.REPLICA_SCALE
+                        and spec.action == "drain"):
+                    replica = eng._check_replica(spec.replica)
+                    with self._engine_locked():
+                        src_tick = eng.replicas[replica].tick_count
+                        result = eng.reconfigure(spec, resubmit=False)
+                    displaced = result.detail.pop("displaced", [])
+                    moved, failed = self._requeue_displaced(displaced,
+                                                           src_tick)
+                    result.detail["resubmitted"] = moved
+                    result.detail["failed"] = failed
+                    if failed:
+                        result.ok = False
+                        result.reason = (f"{len(failed)} displaced "
+                                         "request(s) found no sibling "
+                                         "capacity")
+                else:
+                    with self._engine_locked():
+                        result = eng.reconfigure(spec)
+        except (reconfig_lib.ReconfigError, ValueError) as exc:
+            # a REFUSED spec changed nothing: the caller gets the error,
+            # the engine keeps serving, and no fault is charged
+            fut.set_exception(exc)
+            return
+        except BaseException as exc:  # noqa: BLE001 — the fault contract logs it
+            if not self._free_running:
+                self._handle_engine_fault(exc)
+            else:
+                # the crash points guarantee a clean old-or-new config
+                # with the displaced work parked, so no recover is needed
+                # — and an unscoped fleet recover would race the other
+                # replica loops. Log it like a fault, resume serving.
+                if self._sentinel is not None:
+                    self._sentinel.note_fault(error=type(exc).__name__)
+                if self._flight is not None:
+                    try:
+                        self._flight.dump("reconfig-fault",
+                                          extra={"error": repr(exc)})
+                    except Exception:  # noqa: BLE001
+                        pass
+            fut.set_exception(exc)
+            return
+        if self._flight is not None:
+            try:  # best-effort, like every other postmortem
+                self._flight.dump("reconfig", extra=result.to_dict())
+            except Exception:  # noqa: BLE001
+                pass
+        fut.set_result(result)
+
+    def _requeue_displaced(self, displaced, src_tick: int):
+        """Re-dispatch a drained replica's displaced requests across the
+        fleet, REBINDING each stream handle to its request's new id —
+        the fault-requeue machinery's planned-maintenance twin (replays
+        from scratch; greedy replay is token-identical)."""
+        moved: Dict[int, int] = {}
+        failed: List[int] = []
+        for req in displaced:
+            with self._hlock:
+                handle = self._handles.pop(req.request_id, None)
+                n = self._requeues.pop(req.request_id, 0)
+            if handle is None:
+                continue  # already finished/cancelled: nothing to move
+            handle._restart()
+            remaining = (None if req.deadline_tick is None
+                         else max(0, req.deadline_tick - src_tick))
+            try:
+                if self._free_running:
+                    rid, _ = self._dispatch_free(
+                        req.prompt, req.max_new_tokens, handle=handle,
+                        eos_id=req.eos_id, rng_seed=req.rng_seed,
+                        deadline_ticks=remaining,
+                    )
+                else:
+                    with self._lock:
+                        rid = self._engine.submit(
+                            req.prompt, req.max_new_tokens,
+                            eos_id=req.eos_id, rng_seed=req.rng_seed,
+                            deadline_ticks=remaining,
+                        )
+                    with self._hlock:
+                        handle.request_id = rid
+                        self._handles[rid] = handle
+            except Exception as exc:  # noqa: BLE001 — no sibling capacity
+                handle._fail(exc)
+                failed.append(req.request_id)
+                continue
+            moved[req.request_id] = rid
+            if n:
+                with self._hlock:
+                    if rid in self._handles:
+                        self._requeues[rid] = n
+        return moved, failed
+
     def stop(self) -> None:
         """Stop the loop and close the engine. Re-raises (wrapped) any
         engine failure the loop died from — an engine death is loud at the
@@ -416,6 +606,12 @@ class ServingServer:
             for wd in self._watchdogs:
                 wd.stop()
         self._abort_handles("aborted")  # in-flight requests must not hang
+        with self._hlock:
+            jobs = list(self._reconfigs)
+            self._reconfigs.clear()
+        for _, fut in jobs:  # unapplied reconfigs must not hang waiters
+            fut.set_exception(RuntimeError(
+                "server stopped before the reconfiguration ran"))
         if wedged:
             # daemon thread stuck in a dispatch holding _lock: it dies with
             # the process; touching the engine here would deadlock
@@ -496,6 +692,23 @@ class ServingServer:
                 "swap_bytes_in": m.swap_bytes_in,
                 "governed": policy.governed(engine.tick_count),
             }
+        store = getattr(engine, "_swap_store", None)
+        if store is not None:
+            # the bounded host store's live view: how much host memory
+            # parked K/V holds right now, against what cap, and how many
+            # records the cap has already evicted to re-prefill
+            out["swap_store"] = {
+                "held_bytes": store.held_bytes,
+                "max_bytes": store.max_bytes,
+                "records": len(store),
+                "evictions": store.evictions,
+            }
+        last = getattr(engine, "last_reconfig", None)
+        if last is not None:
+            out["last_reconfig"] = last.to_dict()
+        reconfigs = getattr(engine.metrics, "reconfigs", None)
+        if reconfigs:
+            out["reconfigs"] = dict(reconfigs)
         return out
 
     def stats(self) -> Dict:
@@ -652,8 +865,12 @@ class ServingServer:
             handles = list(self._handles.values())
             self._handles.clear()
             self._requeues.clear()
+            jobs = list(self._reconfigs)
+            self._reconfigs.clear()
         for handle in handles:
             handle._fail(error)
+        for _, fut in jobs:  # a dead loop can never apply them
+            fut.set_exception(error)
 
     def _on_stall(self, elapsed: float) -> None:
         # runs on the watchdog thread; must not touch self._lock (the
@@ -823,6 +1040,8 @@ class ServingServer:
                         return  # stall/give-up already failed the handles
                     nudge = (self._nudges.pop(next(iter(self._nudges)))
                              if self._nudges else None)
+                    job = (self._reconfigs.popleft()
+                           if nudge is None and self._reconfigs else None)
                 if nudge is not None:
                     # a sentinel remediation: run the PROVEN fault path —
                     # recover, bounded requeue, flight dump — on the loop
@@ -830,6 +1049,12 @@ class ServingServer:
                     # lockstep engine recovers whole; a replica target is
                     # a free-running concept, so any pending nudge counts)
                     self._handle_engine_fault(SentinelRemediation(nudge))
+                    continue
+                if job is not None:
+                    # a pending live reconfiguration: quiesce, preempt,
+                    # rebuild, resume — on the loop thread, watchdog and
+                    # sentinel leases suspended
+                    self._execute_reconfig(*job)
                     continue
                 try:
                     with self._lock:
@@ -935,9 +1160,18 @@ class ServingServer:
                     nudge = self._nudges.pop(i, None)
                     if nudge is None and None in self._nudges:
                         nudge = self._nudges.pop(None)
+                    job = (self._reconfigs.popleft()
+                           if nudge is None and self._reconfigs else None)
                 if nudge is not None:
                     self._handle_engine_fault(SentinelRemediation(nudge),
                                               replica=i)
+                    continue
+                if job is not None:
+                    # first loop to poll claims the WHOLE reconfiguration
+                    # (fleet-wide ops take every replica lock in order;
+                    # the other loops just block on their own lock for
+                    # the duration)
+                    self._execute_reconfig(*job)
                     continue
                 try:
                     with lock:

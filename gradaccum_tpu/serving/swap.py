@@ -34,6 +34,14 @@ class SwapError(RuntimeError):
     pool (the engine falls back to re-prefill)."""
 
 
+class SwapCapacityError(OSError):
+    """A single record is larger than the store's ``max_bytes`` cap — it
+    can never be held. An OSError on purpose: the engine's put-side
+    fallback (drop the swap, resume by re-prefill) already catches
+    OSError, so an over-large victim degrades exactly like a failed
+    swap write."""
+
+
 @dataclasses.dataclass
 class SwapRecord:
     """One preempted request's host-side K/V. ``arrays`` maps names
@@ -65,12 +73,30 @@ class HostSwapStore:
     fail either direction of the swap; both directions propagate
     ``OSError`` to the engine, whose fallback is always re-prefill —
     swap is an optimization, never a correctness dependency.
+
+    ``max_bytes`` BOUNDS the store: without it a preemption storm grows
+    host memory with every victim. When a ``put`` would exceed the cap,
+    the OLDEST parked records are evicted first (FIFO — the newest victim
+    is the likeliest to resume soon under the engine's FIFO re-admission,
+    and the oldest has waited longest behind it); an evicted request's
+    next resume attempt finds no record and falls back to re-prefill
+    through the engine's existing KeyError path, so eviction costs
+    recompute, never correctness. A single record larger than the cap
+    raises :class:`SwapCapacityError` (an OSError) so the engine's
+    put-side fallback drops the swap immediately. ``held_bytes`` is O(1)
+    (the live gauge on /metrics and ``stats()``).
     """
 
-    def __init__(self):
+    def __init__(self, max_bytes: Optional[int] = None):
+        if max_bytes is not None and int(max_bytes) < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        self.max_bytes = None if max_bytes is None else int(max_bytes)
+        # dict insertion order IS the eviction order (oldest parked first)
         self._recs: Dict[int, SwapRecord] = {}
         self.bytes_out = 0  # cumulative device->host
         self.bytes_in = 0   # cumulative host->device (successful gets)
+        self.evictions = 0  # records dropped to honor max_bytes
+        self._held = 0      # live bytes, maintained incrementally
 
     def __len__(self) -> int:
         return len(self._recs)
@@ -80,7 +106,7 @@ class HostSwapStore:
 
     @property
     def held_bytes(self) -> int:
-        return sum(r.nbytes for r in self._recs.values())
+        return self._held
 
     def put(self, rid: int, arrays: Dict[str, np.ndarray], page_start: int,
             length: int) -> SwapRecord:
@@ -91,8 +117,22 @@ class HostSwapStore:
         rec = SwapRecord(arrays=arrays, page_start=int(page_start),
                          length=int(length), digest="",
                          nbytes=sum(a.nbytes for a in arrays.values()))
+        if self.max_bytes is not None:
+            if rec.nbytes > self.max_bytes:
+                raise SwapCapacityError(
+                    f"swap record for request {rid} is {rec.nbytes} bytes "
+                    f"but the store caps at {self.max_bytes} — resuming by "
+                    "re-prefill instead"
+                )
+            # replacing an existing record must not count the old bytes
+            self.discard(rid)
+            while self._recs and self._held + rec.nbytes > self.max_bytes:
+                oldest = next(iter(self._recs))
+                self.discard(oldest)
+                self.evictions += 1
         rec.digest = rec.compute_digest()
         self._recs[int(rid)] = rec
+        self._held += rec.nbytes
         self.bytes_out += rec.nbytes
         return rec
 
@@ -111,7 +151,11 @@ class HostSwapStore:
         return rec
 
     def discard(self, rid: int) -> bool:
-        return self._recs.pop(int(rid), None) is not None
+        rec = self._recs.pop(int(rid), None)
+        if rec is not None:
+            self._held -= rec.nbytes
+        return rec is not None
 
     def clear(self) -> None:
         self._recs.clear()
+        self._held = 0

@@ -1,0 +1,674 @@
+"""Live reconfiguration under traffic: hot pool resize, checkpoint swap,
+replica scale, host liveness leases — the `reconfig` tier-1 gates.
+
+The headline contract mirrors crash-resume: a pool resize or checkpoint
+swap applied mid-stream completes every in-flight request with ZERO drops
+and token-for-token parity vs an unreconfigured run (greedy and sampled,
+swap-in and re-prefill resume legs both covered), a shrink below live
+demand refuses with a structured error, a corrupt checkpoint degrades to
+quarantine-and-keep-serving, and a MID_RECONFIG kill lands in a clean
+old-or-new configuration — never a torn pool. The satellites gate the
+watchdog/sentinel maintenance suspension, the bounded host swap store,
+and the slow-vs-gone host lease on the drain-consensus transport.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+from gradaccum_tpu.models.gpt_decode import generate_cached
+from gradaccum_tpu.resilience import faults
+from gradaccum_tpu.resilience.faults import (
+    FaultInjector,
+    FaultSchedule,
+    FaultSpec,
+)
+from gradaccum_tpu.resilience.preemption import DrainConsensus, LocalDrainBus
+from gradaccum_tpu.resilience.watchdog import Watchdog
+from gradaccum_tpu.serving import (
+    Engine,
+    HostSwapStore,
+    ReconfigError,
+    ReplicatedEngine,
+    ServingServer,
+    checkpoint_swap,
+    pool_resize,
+    replica_activate,
+    replica_drain,
+)
+from gradaccum_tpu.serving import reconfig as reconfig_lib
+
+pytestmark = pytest.mark.reconfig
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return GPTConfig.tiny_for_tests(dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    bundle = gpt_lm_bundle(cfg)
+    return bundle.init(jax.random.PRNGKey(0),
+                       {"input_ids": np.zeros((1, 8), np.int32)})
+
+
+@pytest.fixture(scope="module")
+def other_params(cfg):
+    bundle = gpt_lm_bundle(cfg)
+    return bundle.init(jax.random.PRNGKey(99),
+                       {"input_ids": np.zeros((1, 8), np.int32)})
+
+
+def _prompts(n, cfg, seed=0, lo=2, hi=8):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab_size,
+                         size=(int(rng.integers(lo, hi)),)).astype(np.int32)
+            for _ in range(n)]
+
+
+def _drain_and_check(engine, params, cfg, rid_prompt_new, **gen_kwargs):
+    """Run to idle; every request must finish ("done") with tokens equal
+    to a solo unreconfigured decode of the same (prompt, seed)."""
+    engine.run_until_idle()
+    for rid, (prompt, max_new, seed) in rid_prompt_new.items():
+        toks, status = engine.pop_result(rid)
+        assert status == "done", (rid, status)
+        want = np.asarray(generate_cached(
+            params, cfg, prompt, max_new,
+            **({"rng": jax.random.PRNGKey(seed), **gen_kwargs}
+               if gen_kwargs else {})
+        ))[0, prompt.size:]
+        np.testing.assert_array_equal(np.asarray(toks), want)
+
+
+# -- pool resize --------------------------------------------------------------
+
+
+def test_pool_grow_parity_under_traffic(cfg, params):
+    eng = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                 num_blocks=16)
+    reqs = {}
+    for p in _prompts(4, cfg, seed=1):
+        reqs[eng.submit(p, 10)] = (p, 10, 0)
+    for _ in range(3):
+        eng.step()
+    res = eng.reconfigure(pool_resize(24))
+    assert res.ok and res.kind == "pool_resize"
+    assert res.preempted > 0  # requests were genuinely in flight
+    assert eng.num_blocks == 24 and eng.pool.num_blocks == 24
+    _drain_and_check(eng, params, cfg, reqs)
+    assert eng.metrics.reconfigs == {"pool_resize": 1}
+
+
+@pytest.mark.parametrize("swap", ["host", "recompute"])
+def test_pool_shrink_under_load_parity(cfg, params, swap):
+    """Shrink under live traffic: both resume legs (swap-in scatter and
+    re-prefill) produce token-for-token identical streams."""
+    eng = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                 num_blocks=24, admission="optimistic", swap=swap)
+    reqs = {}
+    for p in _prompts(4, cfg, seed=2):
+        reqs[eng.submit(p, 10)] = (p, 10, 0)
+    for _ in range(3):
+        eng.step()
+    res = eng.reconfigure(pool_resize(12))
+    assert res.ok and res.preempted > 0
+    _drain_and_check(eng, params, cfg, reqs)
+    m = eng.metrics
+    if swap == "host":
+        assert m.swap_ins > 0  # the swap leg actually exercised
+    else:
+        assert m.reprefills > 0
+
+
+def test_prefix_pool_reconfig_parity_and_resharing(cfg, params):
+    """A prefix-shared pool resizes cleanly (shared blocks vanish with
+    the old pool; resumes fall back per the adoption rule) and the
+    rebuilt pool starts sharing again."""
+    sys_prompt = _prompts(1, cfg, seed=3, lo=8, hi=9)[0]
+    rng = np.random.default_rng(4)
+    prompts = [np.concatenate([sys_prompt,
+                               rng.integers(0, cfg.vocab_size, 3)
+                               .astype(np.int32)]) for _ in range(4)]
+    eng = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                 num_blocks=24, prefix_cache=True)
+    reqs = {}
+    for p in prompts[:2]:
+        reqs[eng.submit(p, 8)] = (p, 8, 0)
+    for _ in range(3):
+        eng.step()
+    res = eng.reconfigure(pool_resize(16))
+    assert res.ok
+    assert len(eng.prefix_cache) == 0  # no stale hash survived the rebuild
+    hits_before = eng.metrics.prefix_hits
+    for p in prompts[2:]:
+        reqs[eng.submit(p, 8)] = (p, 8, 0)
+    _drain_and_check(eng, params, cfg, reqs)
+    assert eng.metrics.prefix_hits > hits_before  # sharing resumed
+
+
+def test_sampled_parity_through_reconfig(cfg, params):
+    """Seeded sampling survives the preempt→rebuild→resume cycle: the
+    per-request rng stream folds position indices, and the resume
+    restores them exactly."""
+    def run(reconfig):
+        eng = Engine(params, cfg, num_slots=3, max_len=32, page_size=4,
+                     num_blocks=18, temperature=0.8, top_k=5)
+        rids = []
+        for i, p in enumerate(_prompts(3, cfg, seed=5)):
+            rids.append(eng.submit(p, 8, rng_seed=100 + i))
+        for _ in range(3):
+            eng.step()
+        if reconfig:
+            assert eng.reconfigure(pool_resize(24)).ok
+        eng.run_until_idle()
+        return [tuple(eng.pop_result(r)[0]) for r in rids]
+
+    assert run(reconfig=True) == run(reconfig=False)
+
+
+def test_shrink_refuses_below_demand(cfg, params):
+    eng = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                 num_blocks=16)
+    p = _prompts(1, cfg, seed=6, lo=6, hi=7)[0]
+    rid = eng.submit(p, 20)
+    eng.step()
+    with pytest.raises(ReconfigError) as ei:
+        eng.reconfigure(pool_resize(2))
+    assert ei.value.demand is not None and ei.value.supply == 2
+    assert ei.value.demand > 2
+    # refusal changed NOTHING: same pool, request runs to completion
+    assert eng.num_blocks == 16
+    eng.run_until_idle()
+    toks, status = eng.pop_result(rid)
+    want = np.asarray(generate_cached(params, cfg, p, 20))[0, p.size:]
+    assert status == "done"
+    np.testing.assert_array_equal(np.asarray(toks), want)
+
+
+def test_resize_refused_on_fixed_pool(cfg, params):
+    eng = Engine(params, cfg, num_slots=2, max_len=32)
+    with pytest.raises(ReconfigError):
+        eng.reconfigure(pool_resize(8))
+
+
+def test_reconfiguring_stall_label(cfg, params):
+    """Fresh traffic held by the quiesce is named, like PR-12's
+    held_by_quantile_gate."""
+    eng = Engine(params, cfg, num_slots=1, max_len=32, page_size=4,
+                 num_blocks=8)
+    prompts = _prompts(2, cfg, seed=7)
+    reqs = {eng.submit(prompts[0], 6): (prompts[0], 6, 0)}
+    eng.step()
+    reqs[eng.submit(prompts[1], 6)] = (prompts[1], 6, 0)  # queued behind
+    assert eng.reconfigure(pool_resize(12)).ok
+    assert eng.scheduler.stalls.get("reconfiguring", 0) >= 1
+    _drain_and_check(eng, params, cfg, reqs)
+
+
+# -- checkpoint swap ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_checkpoint_swap_same_weights_parity(cfg, params, paged, tmp_path):
+    """A config-only redeploy (identical weights, sha-manifested file on
+    disk) is invisible token-wise: swapped K/V stays valid and the
+    resumed streams match an unreconfigured run exactly."""
+    from gradaccum_tpu.estimator import checkpoint as ckpt_lib
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt_lib.save(ckpt_dir, jax.device_get(params), step=1)
+    kwargs = dict(page_size=4, num_blocks=16) if paged else {}
+    eng = Engine(params, cfg, num_slots=3, max_len=32, **kwargs)
+    reqs = {}
+    for p in _prompts(3, cfg, seed=8):
+        reqs[eng.submit(p, 10)] = (p, 10, 0)
+    for _ in range(3):
+        eng.step()
+    res = eng.reconfigure(checkpoint_swap(checkpoint=ckpt_dir))
+    assert res.ok and res.detail["weights_unchanged"] is True
+    assert eng.metrics.swap_ins == 0  # nothing resumed before the drain
+    _drain_and_check(eng, params, cfg, reqs)
+    assert eng.metrics.swap_ins > 0  # the swap-in leg carried the resume
+
+
+def test_checkpoint_swap_new_weights_continuation(cfg, params, other_params):
+    """Changed weights force re-prefill resumes; the continuation is the
+    greedy decode of (prompt + generated-so-far) under the NEW weights —
+    no stream decodes new weights against old K/V."""
+    eng = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                 num_blocks=16)
+    p = _prompts(1, cfg, seed=9)[0]
+    rid = eng.submit(p, 10)
+    for _ in range(4):
+        eng.step()
+    g = len(eng.results[rid])
+    assert g > 0
+    res = eng.reconfigure(checkpoint_swap(params=other_params))
+    assert res.ok and res.detail["weights_unchanged"] is False
+    eng.run_until_idle()
+    toks, status = eng.pop_result(rid)
+    assert status == "done"
+    pre = np.asarray(generate_cached(params, cfg, p, 10))[0, p.size:p.size + g]
+    np.testing.assert_array_equal(np.asarray(toks[:g]), pre)
+    ext = np.concatenate([p, np.asarray(toks[:g], np.int32)])
+    tail = np.asarray(generate_cached(other_params, cfg, ext,
+                                      10 - g))[0, ext.size:]
+    np.testing.assert_array_equal(np.asarray(toks[g:]), tail)
+    assert eng.metrics.reprefills > 0 and eng.metrics.swap_ins == 0
+
+
+def test_checkpoint_swap_corrupt_quarantines_and_keeps_serving(
+        cfg, params, tmp_path):
+    from gradaccum_tpu.estimator import checkpoint as ckpt_lib
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    path = ckpt_lib.save(ckpt_dir, jax.device_get(params), step=1)
+    with open(path, "r+b") as f:  # rot a byte AFTER the manifest recorded
+        f.seek(10)
+        f.write(b"\xff\xff\xff\xff")
+    eng = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                 num_blocks=16)
+    reqs = {}
+    for p in _prompts(2, cfg, seed=10):
+        reqs[eng.submit(p, 8)] = (p, 8, 0)
+    for _ in range(2):
+        eng.step()
+    res = eng.reconfigure(checkpoint_swap(checkpoint=ckpt_dir))
+    assert not res.ok and "rejected" in res.reason
+    assert res.detail["quarantined"]
+    assert eng.metrics.reconfig_failures == 1
+    # the old weights KEPT serving — nothing was preempted, parity holds
+    assert res.preempted == 0
+    _drain_and_check(eng, params, cfg, reqs)
+
+
+# -- fault injection through reconfig ----------------------------------------
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("at,expect_new", [(0, False), (1, True)])
+def test_mid_reconfig_crash_lands_clean(cfg, params, at, expect_new):
+    """A kill mid-rebuild recovers to either the old (pre-rebuild crash
+    point) or the new (post-rebuild) configuration CLEANLY: everything
+    is parked, the pool is never torn, and the parked work drains with
+    full token parity."""
+    eng = Engine(params, cfg, num_slots=3, max_len=32, page_size=4,
+                 num_blocks=16)
+    reqs = {}
+    for p in _prompts(3, cfg, seed=11):
+        reqs[eng.submit(p, 8)] = (p, 8, 0)
+    for _ in range(3):
+        eng.step()
+    inj = FaultInjector(FaultSchedule([FaultSpec(faults.MID_RECONFIG,
+                                                 at=at)]))
+    with faults.installed(inj):
+        with pytest.raises(faults.InjectedCrash):
+            eng.reconfigure(pool_resize(8))
+    assert inj.fired == [(faults.MID_RECONFIG, at, faults.KIND_CRASH)]
+    assert eng.num_blocks == (8 if expect_new else 16)
+    assert eng.pool.num_blocks == eng.num_blocks  # never torn
+    assert eng.pool.active_count == 0  # everything parked, nothing resident
+    assert not eng.reconfiguring
+    _drain_and_check(eng, params, cfg, reqs)
+
+
+@pytest.mark.faults
+def test_server_reconfig_crash_routes_through_fault_contract(cfg, params):
+    """Through the threaded server, a crash-point kill fails the future,
+    charges the fault contract, and every stream still completes."""
+    eng = Engine(params, cfg, num_slots=3, max_len=32, page_size=4,
+                 num_blocks=16)
+    inj = FaultInjector(FaultSchedule([FaultSpec(faults.MID_RECONFIG,
+                                                 at=0)]))
+    prompts = _prompts(3, cfg, seed=12)
+    with faults.installed(inj):
+        server = ServingServer(eng).start()
+        handles = [server.submit(p, 8) for p in prompts]
+        fut = server.request_reconfig(pool_resize(8))
+        with pytest.raises(faults.InjectedCrash):
+            fut.result(timeout=60)
+        for p, h in zip(prompts, handles):
+            toks, reason = h.result(timeout=60)
+            assert reason == "length"
+            want = np.asarray(generate_cached(params, cfg, p, 8))[0, p.size:]
+            np.testing.assert_array_equal(np.asarray(toks), want)
+        server.stop()
+
+
+# -- replica scale ------------------------------------------------------------
+
+
+def test_replica_drain_and_activate_engine_level(cfg, params):
+    fleet = ReplicatedEngine(params, cfg, replicas=2, tp=None, num_slots=3,
+                             max_len=32)
+    prompts = _prompts(4, cfg, seed=13)
+    rids = [fleet.submit(p, 8) for p in prompts]
+    for _ in range(2):
+        fleet.step()
+    res = fleet.reconfigure(replica_drain(1))
+    assert res.ok and res.detail["active_replicas"] == [0]
+    assert not res.detail["failed"]
+    moved = res.detail["resubmitted"]
+    # the drained replica is empty and out of the dispatch order
+    assert fleet.replicas[1].idle
+    assert all(r % 2 == 0 for r in
+               [fleet.submit(p, 4) for p in _prompts(2, cfg, seed=14)])
+    fleet.run_until_idle()
+    for p, rid in zip(prompts, rids):
+        toks, status = fleet.pop_result(moved.get(rid, rid))
+        assert status == "done"
+        want = np.asarray(generate_cached(params, cfg, p, 8))[0, p.size:]
+        np.testing.assert_array_equal(np.asarray(toks), want)
+    assert fleet.reconfigure(replica_activate(1)).ok
+    assert fleet.active_replicas == [0, 1]
+
+
+def test_server_replica_drain_rebinds_handles(cfg, params):
+    """Through the server, a drained replica's streams keep their
+    handles: the displaced requests re-dispatch across the fleet and
+    every caller's result() returns the full parity-clean generation."""
+    fleet = ReplicatedEngine(params, cfg, replicas=2, tp=None, num_slots=4,
+                             max_len=32)
+    server = ServingServer(fleet).start()
+    prompts = _prompts(4, cfg, seed=15)
+    handles = [server.submit(p, 10) for p in prompts]
+    result = server.reconfigure(replica_drain(1), timeout=60)
+    assert result.ok and not result.detail["failed"]
+    for p, h in zip(prompts, handles):
+        toks, reason = h.result(timeout=60)
+        assert reason == "length"
+        want = np.asarray(generate_cached(params, cfg, p, 10))[0, p.size:]
+        np.testing.assert_array_equal(np.asarray(toks), want)
+    server.stop()
+
+
+def test_fleet_shrink_refusal_never_tears(cfg, params):
+    """A refusal on ANY replica must refuse the whole fleet BEFORE any
+    replica rebuilds — never a mixed-block-count fleet."""
+    fleet = ReplicatedEngine(params, cfg, replicas=2, tp=None, num_slots=2,
+                             max_len=32, page_size=4, num_blocks=16)
+    reqs = {}
+    for p in _prompts(2, cfg, seed=19, lo=6, hi=8):
+        reqs[fleet.submit(p, 20)] = p
+    fleet.step()
+    with pytest.raises(ReconfigError):
+        fleet.reconfigure(pool_resize(2))
+    assert all(e.num_blocks == 16 for e in fleet.replicas)
+    fleet.run_until_idle()
+    for rid, p in reqs.items():
+        toks, status = fleet.pop_result(rid)
+        assert status == "done"
+        want = np.asarray(generate_cached(params, cfg, p, 20))[0, p.size:]
+        np.testing.assert_array_equal(np.asarray(toks), want)
+
+
+def test_drain_replica_parks_sentinel_lease(cfg, params):
+    """Draining a busy replica parks its heartbeat lease: the planned
+    silence must not fire dead_replica (and its recover remediation)."""
+    from gradaccum_tpu.obs.sentinel import Sentinel
+
+    clk = [0.0]
+    snt = Sentinel(clock=lambda: clk[0], lease=1.0)
+    fleet = ReplicatedEngine(params, cfg, replicas=2, tp=None, num_slots=4,
+                             max_len=32, sentinel=snt)
+    for p in _prompts(4, cfg, seed=20):
+        fleet.submit(p, 8)
+    fleet.step()  # both replicas heartbeat busy
+    fleet.reconfigure(replica_drain(1))
+    clk[0] = 10.0  # far past the lease with replica 1 silent by design
+    fired = snt.check()
+    assert all(a.replica != 1 for a in fired), fired
+    fleet.run_until_idle()
+
+
+def test_server_free_running_pool_resize(cfg, params):
+    fleet = ReplicatedEngine(params, cfg, replicas=2, tp=None, num_slots=3,
+                             max_len=32, page_size=4, num_blocks=16)
+    server = ServingServer(fleet, free_running=True).start()
+    prompts = _prompts(4, cfg, seed=16)
+    handles = [server.submit(p, 10) for p in prompts]
+    result = server.reconfigure(pool_resize(24), timeout=60)
+    assert result.ok
+    assert all(e.num_blocks == 24 for e in fleet.replicas)
+    for p, h in zip(prompts, handles):
+        toks, reason = h.result(timeout=60)
+        assert reason == "length"
+        want = np.asarray(generate_cached(params, cfg, p, 10))[0, p.size:]
+        np.testing.assert_array_equal(np.asarray(toks), want)
+    server.stop()
+
+
+# -- watchdog / sentinel maintenance ------------------------------------------
+
+
+def test_watchdog_suspend_blocks_false_stall():
+    fired = []
+    wd = Watchdog(timeout=0.05, on_stall=fired.append, poll=0.01).start()
+    try:
+        wd.arm()
+        with wd.suspend():
+            time.sleep(0.15)  # a planned long operation
+            wd.arm()          # arms inside the window are ignored
+            time.sleep(0.1)
+        assert not fired
+        wd.arm()
+        time.sleep(0.2)
+        assert fired  # real stalls still fire after the window closes
+    finally:
+        wd.stop()
+
+
+def test_watchdog_suspend_restores_open_window():
+    """A window open when suspension begins RESTARTS at exit: the rest
+    of the armed dispatch keeps stall detection (no re-arm needed) —
+    pool-pressure ticks must not run unwatched after a swap burst."""
+    fired = []
+    wd = Watchdog(timeout=0.05, on_stall=fired.append, poll=0.01).start()
+    try:
+        wd.arm()
+        with wd.suspend():
+            time.sleep(0.12)  # planned work far past the timeout
+        assert not fired
+        time.sleep(0.2)  # the SAME dispatch wedges after the burst
+        assert fired
+    finally:
+        wd.stop()
+
+
+def test_sentinel_maintenance_pauses_leases():
+    from gradaccum_tpu.obs.sentinel import Sentinel
+
+    clk = [0.0]
+    snt = Sentinel(clock=lambda: clk[0], lease=1.0)
+    snt.heartbeat(tick=1, busy=True)
+    with snt.maintenance():
+        clk[0] = 10.0  # far past the lease
+        assert snt.check() == []
+    # leases restarted at exit: the maintenance window never counts
+    assert snt.check() == []
+    clk[0] = 25.0
+    assert [a.kind for a in snt.check()] == ["stall"]
+
+
+# -- bounded host swap store --------------------------------------------------
+
+
+def test_swap_store_max_bytes_evicts_oldest():
+    st = HostSwapStore(max_bytes=100)
+    arr = {"k": np.zeros(10, np.float32)}  # 40 bytes/record
+    st.put(1, arr, 0, 4)
+    st.put(2, arr, 0, 4)
+    assert st.held_bytes == 80
+    st.put(3, arr, 0, 4)  # evicts rid 1 (oldest parked)
+    assert st.held_bytes == 80 and st.evictions == 1
+    assert 1 not in st and 2 in st and 3 in st
+    with pytest.raises(OSError):  # an over-large record can never be held
+        st.put(4, {"k": np.zeros(100, np.float32)}, 0, 4)
+    st.discard(2)
+    assert st.held_bytes == 40
+
+
+def test_engine_swap_cap_degrades_to_reprefill(cfg, params):
+    """A capped store under preemption pressure evicts to re-prefill —
+    host memory stays bounded, token streams stay parity-clean."""
+    eng = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                 num_blocks=12, admission="optimistic", swap="host",
+                 swap_max_bytes=1)  # nothing fits: every swap degrades
+    assert eng.manifest()["swap_max_bytes"] == 1
+    reqs = {}
+    for p in _prompts(4, cfg, seed=17):
+        reqs[eng.submit(p, 10)] = (p, 10, 0)
+    _drain_and_check(eng, params, cfg, reqs)
+    m = eng.metrics
+    if m.preemptions:  # pressure happened: swap had to degrade
+        assert m.swap_fallbacks > 0 and m.swap_ins == 0
+    assert eng._swap_store.held_bytes == 0
+
+
+def test_swap_store_bytes_gauge_on_metrics(cfg, params):
+    """A pressure-driven preemption leaves its record in the store when
+    the tick's gauges sample — the host-memory bill is visible on
+    /metrics while the storm is happening, not only after."""
+    eng = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                 num_blocks=8, admission="optimistic", swap="host")
+    reqs = {}
+    for p in _prompts(4, cfg, seed=18, lo=4, hi=8):
+        reqs[eng.submit(p, 12)] = (p, 12, 0)
+    peak = 0
+    while not eng.idle:
+        eng.step()
+        peak = max(peak, eng.metrics.swap_store_bytes)
+    assert eng.metrics.preemptions > 0  # the tight pool forced evictions
+    assert peak > 0  # ...and some tick ENDED with bytes parked on host
+    assert "serving/swap_store_bytes" in eng.metrics.to_prometheus()
+    _drain_and_check(eng, params, cfg, reqs)
+
+
+# -- host liveness leases -----------------------------------------------------
+
+
+def test_host_lease_distinguishes_gone_from_slow():
+    clk = [0.0]
+    # GONE: the peer's lease expired -> the survivor resolves the round
+    # with its own submission immediately, NOT after the 30s barrier
+    bus = LocalDrainBus(2, timeout=30.0, lease_ttl=1.0,
+                        clock=lambda: clk[0])
+    bus.renew(1, now=0.0)
+    clk[0] = 5.0
+    t0 = time.monotonic()
+    assert bus.exchange(0, True, 7) == (True, 7)
+    assert time.monotonic() - t0 < 5.0
+    assert bus.partial_rounds == 1 and bus.last_partial() == (1,)
+
+    # SLOW: the peer's lease is fresh -> the survivor WAITS and the round
+    # completes with both contributions once the peer arrives
+    bus2 = LocalDrainBus(2, timeout=30.0, lease_ttl=60.0,
+                         clock=lambda: clk[0])
+    bus2.renew(1, now=clk[0])
+    out = {}
+
+    def late_host():
+        time.sleep(0.25)
+        out[1] = bus2.exchange(1, False, 9)
+
+    th = threading.Thread(target=late_host)
+    th.start()
+    res = bus2.exchange(0, True, 7)
+    th.join()
+    assert res == (True, 9) == out[1]  # max-step says host 1 arrived
+    assert bus2.partial_rounds == 0
+
+
+def test_host_lease_unknown_is_not_gone():
+    """A host that NEVER renewed is unknown, not gone — maybe late to
+    start. Only proven departure (renewed once, then expired) may
+    shortcut the barrier; unknown degrades to the plain timeout."""
+    clk = [0.0]
+    bus = LocalDrainBus(2, timeout=0.3, lease_ttl=1.0,
+                        clock=lambda: clk[0])
+    with pytest.raises(TimeoutError):
+        bus.exchange(0, True, 7)
+    assert bus.partial_rounds == 0
+
+
+def test_drain_consensus_lease_api():
+    clk = [0.0]
+    bus = LocalDrainBus(2, timeout=30.0, clock=lambda: clk[0])
+    c0 = DrainConsensus(multiprocess=False, bus=bus, host_id=0,
+                        lease_ttl=1.0)
+    c1 = DrainConsensus(multiprocess=False, bus=bus, host_id=1,
+                        lease_ttl=1.0)
+    assert bus.lease_ttl == 1.0  # the consensus knob armed the bus
+    c0.renew_lease(now=0.0)
+    c1.renew_lease(now=0.0)
+    assert c0.peer_liveness(now=0.5) == {0: "live", 1: "live"}
+    clk[0] = 5.0
+    assert c0.peer_liveness(now=5.0) == {0: "expired", 1: "expired"}
+
+
+def test_agree_reconfig_tick_over_consensus():
+    """A fleet agrees ONE reconfig tick through the drain-consensus
+    exchange: (any host wants it, max of the hosts' ticks)."""
+    bus = LocalDrainBus(2, timeout=30.0)
+    c0 = DrainConsensus(multiprocess=False, bus=bus, host_id=0)
+    c1 = DrainConsensus(multiprocess=False, bus=bus, host_id=1)
+    out = {}
+
+    def host1():
+        out[1] = reconfig_lib.agree_tick(c1, False, 41)
+
+    th = threading.Thread(target=host1)
+    th.start()
+    out[0] = reconfig_lib.agree_tick(c0, True, 38)
+    th.join()
+    assert out[0] == out[1] == (True, 41)
+
+
+# -- misc ---------------------------------------------------------------------
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        pool_resize(0)
+    with pytest.raises(ValueError):
+        checkpoint_swap()  # needs exactly one source
+    with pytest.raises(ValueError):
+        checkpoint_swap(checkpoint="x", params={})
+    with pytest.raises(ValueError):
+        reconfig_lib.ReconfigSpec("nonsense")
+
+
+def test_engine_refuses_replica_scale(cfg, params):
+    eng = Engine(params, cfg, num_slots=2, max_len=32)
+    with pytest.raises(ReconfigError):
+        eng.reconfigure(replica_drain(0))
+
+
+@pytest.mark.slow
+def test_bench_reconfig_fast_structure(tmp_path):
+    """Slow lane: the availability bench runs end to end (--fast) and
+    writes a well-formed artifact clearing its own acceptance bar."""
+    import json
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import bench_reconfig
+
+    out = str(tmp_path / "BENCH_reconfig.json")
+    rc = bench_reconfig.main(["--fast", "--json", out])
+    assert rc == 0
+    with open(out) as f:
+        artifact = json.load(f)
+    assert artifact["acceptance"]["passed"] is True
+    for kind in ("resize", "ckpt_swap"):
+        t = artifact["transition"][kind]
+        assert t["availability_ratio"] > 0
+        assert t["live"]["time_to_recover_ticks"] is not None

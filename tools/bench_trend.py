@@ -93,6 +93,24 @@ def _admission_column(data) -> str:
     return "admission " + " → ".join(parts)
 
 
+def _reconfig_column(data) -> str:
+    """Render a ``transition`` dict (BENCH_reconfig.json) as the
+    live-vs-stop-the-world availability ratios with recovery times."""
+    transition = data.get("transition")
+    if not isinstance(transition, dict) or not transition:
+        return ""
+    try:
+        parts = [
+            f"{kind} {float(t['availability_ratio']):.2f}x "
+            f"(recover {t['live']['time_to_recover_ticks']} vs "
+            f"{t['stw']['time_to_recover_ticks']} ticks)"
+            for kind, t in sorted(transition.items())
+        ]
+    except (KeyError, TypeError, ValueError):
+        return ""
+    return "live-vs-stw " + ", ".join(parts)
+
+
 def _memory_column(data) -> str:
     """Render a mixed-precision ``rows`` ladder (BENCH_mixed.json) as the
     per-replica optimizer+accumulator bytes/param progression."""
@@ -141,6 +159,7 @@ def collect(bench_dir: str):
             "memory": _memory_column(data) or None,
             "spec": _spec_column(data) or None,
             "admission": _admission_column(data) or None,
+            "reconfig": _reconfig_column(data) or None,
             "acceptance": acceptance,
             "passed": None if acceptance is None
             else bool(acceptance.get("passed")),
@@ -211,6 +230,8 @@ def main(argv=None) -> int:
                 detail += f" — {r['spec']}"
             if r.get("admission"):
                 detail += f" — {r['admission']}"
+            if r.get("reconfig"):
+                detail += f" — {r['reconfig']}"
             if required != "":
                 detail += f" [{required}]"
             if not r["passed"]:

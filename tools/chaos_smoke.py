@@ -28,12 +28,22 @@ contract (a latency cliff recovers + requeues with token parity intact; a
 scale storm drains the training job through ``DrainConsensus``), and a
 seeded simulation's SLO alert stream is byte-identical across two runs.
 
+The reconfig phase drives the live-reconfiguration plane through the
+same schedule: a seeded MID_RECONFIG kill on a pool SHRINK under load
+(the engine must land in a clean old-or-new config and the retry must
+apply), a checkpoint swap from a sha-manifested directory, zero dropped
+requests with greedy parity throughout, and a 2-host lease-expiry leg
+where the survivor resolves a gone host's consensus round without
+waiting out the barrier timeout.
+
 Everything is deterministic under the seed (same seed, same chaos, same
 trajectory). Writes ``BENCH_chaos.json`` with an acceptance block that
 ``tools/bench_trend.py`` aggregates, and exits 0 on PASS — wired as the
-``chaos``-marked slow test in tests/test_chaos.py.
+``chaos``-marked slow test in tests/test_chaos.py. ``--seed-range N``
+replays the WHOLE schedule for N consecutive seeds (the nightly sweep
+the ROADMAP asks for); the artifact then nests per-seed detail.
 
-Usage: python tools/chaos_smoke.py [--seed N] [--json PATH]
+Usage: python tools/chaos_smoke.py [--seed N] [--seed-range N] [--json PATH]
 """
 
 import argparse
@@ -61,6 +71,10 @@ def draw_plan(seed: int) -> dict:
         "serve_crash_tick": int(rng.integers(1, 5)),
         "serve_slow_offset": 3,
         "paged_table_tick": int(rng.integers(2, 6)),
+        # reconfig phase: drawn AFTER the existing parameters so the same
+        # seed still replays the same train/serve/paged chaos as before
+        "reconfig_shrink_blocks": int(rng.integers(10, 15)),
+        "reconfig_crash_index": int(rng.integers(0, 2)),
     }
 
 
@@ -378,6 +392,112 @@ def _paged_chaos(seed: int, log, plan):
             "reprefills": m.reprefills}
 
 
+def _reconfig_chaos(seed: int, log, plan):
+    """The live-reconfiguration phase of the ONE seeded schedule: a pool
+    SHRINK under live traffic with a seeded MID_RECONFIG kill on the
+    retry path, then a checkpoint swap from a sha-manifested directory —
+    zero dropped requests and greedy parity through both — plus a 2-host
+    lease-expiry leg proving survivors distinguish a gone host from a
+    slow one without waiting out the barrier timeout."""
+    import tempfile
+    import time
+
+    import jax
+    import numpy as np
+
+    from gradaccum_tpu.estimator import checkpoint as ckpt_lib
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.resilience.faults import (
+        FaultInjector,
+        FaultSchedule,
+        FaultSpec,
+    )
+    from gradaccum_tpu.resilience.preemption import LocalDrainBus
+    from gradaccum_tpu.serving import (
+        Engine,
+        ServingServer,
+        checkpoint_swap,
+        pool_resize,
+    )
+
+    rng = np.random.default_rng(seed + 9)
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(jax.random.PRNGKey(0),
+                         {"input_ids": np.zeros((1, 8), np.int32)})
+    prompts = [
+        rng.integers(0, cfg.vocab_size,
+                     size=(int(rng.integers(3, 8)),)).astype(np.int32)
+        for _ in range(6)
+    ]
+    nb2 = plan["reconfig_shrink_blocks"]
+    crash_idx = plan["reconfig_crash_index"]
+    log(f"[chaos/reconfig] plan: shrink 24->{nb2} blocks under load with "
+        f"a kill at MID_RECONFIG index {crash_idx}, then a checkpoint "
+        "swap")
+    engine = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                    num_blocks=24, admission="optimistic", swap="host")
+    injector = FaultInjector(FaultSchedule([
+        FaultSpec(faults.MID_RECONFIG, at=crash_idx),
+    ]))
+    with tempfile.TemporaryDirectory() as ckpt_dir, \
+            faults.installed(injector):
+        ckpt_lib.save(ckpt_dir, jax.device_get(params), step=1)
+        server = ServingServer(engine, max_requeues=3).start()
+        handles = [server.submit(p, 12) for p in prompts]
+        # first attempt eats the seeded kill; the engine lands in a clean
+        # old-or-new config with everything parked, streams keep going
+        fut = server.request_reconfig(pool_resize(nb2))
+        try:
+            fut.result(timeout=120)
+            crashed = False
+        except faults.InjectedCrash:
+            crashed = True
+        # the retry applies cleanly (the fault budget is spent)
+        result = server.reconfigure(pool_resize(nb2), timeout=120)
+        assert result.ok, f"retry refused: {result.reason}"
+        assert engine.num_blocks == nb2
+        swap_res = server.reconfigure(checkpoint_swap(checkpoint=ckpt_dir),
+                                      timeout=120)
+        assert swap_res.ok and swap_res.detail["weights_unchanged"]
+        results = [h.result(timeout=180) for h in handles]
+        server.stop()
+    assert crashed, "the seeded MID_RECONFIG kill never fired"
+    for prompt, (tokens, reason) in zip(prompts, results):
+        assert reason in ("eos", "length"), reason
+        want = np.asarray(generate_cached(params, cfg, prompt, 12))
+        np.testing.assert_array_equal(np.asarray(tokens),
+                                      want[0, prompt.size:])
+    m = engine.metrics
+    assert m.reconfigs.get("pool_resize", 0) >= 1
+    assert m.reconfigs.get("checkpoint_swap", 0) == 1
+
+    # -- host-lease leg: gone resolves fast, slow is waited for
+    clk = [0.0]
+    bus = LocalDrainBus(2, timeout=60.0, lease_ttl=1.0,
+                        clock=lambda: clk[0])
+    bus.renew(1, now=0.0)
+    clk[0] = 10.0  # host 1's lease long expired: it is GONE
+    t0 = time.monotonic()
+    drain, step = bus.exchange(0, True, 5)
+    waited = time.monotonic() - t0
+    assert (drain, step) == (True, 5)
+    assert waited < 10.0, f"survivor waited {waited}s for a dead host"
+    assert bus.partial_rounds == 1 and bus.last_partial() == (1,)
+    log(f"[chaos/reconfig] PASS: {len(results)} requests parity-clean "
+        f"through kill+shrink+swap (preemptions={m.preemptions}, "
+        f"reconfigs={dict(m.reconfigs)}); gone-host round resolved in "
+        f"{waited * 1000:.0f}ms without the barrier timeout")
+    return {"requests": len(results),
+            "reconfig_kill_fired": crashed,
+            "shrink_blocks": nb2,
+            "reconfigs": dict(m.reconfigs),
+            "preemptions": m.preemptions,
+            "lease_partial_rounds": bus.partial_rounds}
+
+
 def _ops_chaos(seed: int, log):
     """The live-ops-plane gate: every injected fault class raises its
     MATCHING alert, sentinel remediation fires through the existing
@@ -587,56 +707,85 @@ def _ops_chaos(seed: int, log):
     return detail
 
 
+def run_one(seed: int, log) -> dict:
+    """Every chaos phase under ONE seeded plan; returns the detail dict
+    (raises AssertionError on any gate failure)."""
+    import tempfile
+
+    from gradaccum_tpu.obs.trace import Tracer
+    from gradaccum_tpu.obs.trace import installed as tracer_installed
+
+    detail = {}
+    plan = draw_plan(seed)
+    detail["plan"] = dict(plan)
+    log(f"[chaos] unified plan (seed {seed}): {plan}")
+    # one unbounded tracer across all phases: every fault, recover,
+    # resume and request lands on a single correlated timeline, and
+    # nothing is ring-evicted before the assertions read it back
+    with tracer_installed(Tracer(capacity=None)):
+        with tempfile.TemporaryDirectory() as work:
+            detail["train"] = _train_chaos(seed, work, log, plan)
+        detail["serve"] = _serve_chaos(seed, log, plan)
+        detail["paged"] = _paged_chaos(seed, log, plan)
+        detail["reconfig"] = _reconfig_chaos(seed, log, plan)
+        detail["ops"] = _ops_chaos(seed, log)
+    return detail
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seed", type=int, default=0xC8A05)
+    ap.add_argument("--seed-range", type=int, default=1,
+                    help="run the whole schedule for N consecutive seeds "
+                         "(the nightly -m chaos sweep)")
     ap.add_argument("--json", default=None,
                     help="artifact path (default: <repo>/BENCH_chaos.json)")
     args = ap.parse_args(argv)
 
     log = print
-    import tempfile
 
     required = ("ONE seeded schedule across train+serve (kill+storm+ckpt "
                 "IO, serve tick crash+slow tick, paged page-table "
-                "corruption+swap-IO error): clean resume, non-empty final "
-                "checkpoint, greedy serving parity, every injected fault "
-                "in a flight-recorder dump with downstream activity; the "
-                "paged admission plane heals table corruption via "
-                "recover/requeue and degrades swap-IO to re-prefill, "
-                "parity-clean; ops plane: each fault class raises its "
+                "corruption+swap-IO error, reconfig kill+pool-shrink+"
+                "checkpoint-swap under load + host-lease expiry): clean "
+                "resume, non-empty final checkpoint, greedy serving "
+                "parity, every injected fault in a flight-recorder dump "
+                "with downstream activity; the paged admission plane "
+                "heals table corruption via recover/requeue and degrades "
+                "swap-IO to re-prefill, parity-clean; the reconfig plane "
+                "survives a MID_RECONFIG kill, completes shrink+swap "
+                "with zero drops and greedy parity, and a 2-host "
+                "consensus resolves a gone host's round without the "
+                "barrier timeout; ops plane: each fault class raises its "
                 "matching alert (crash->engine_fault, "
                 "slow_tick->latency_cliff, overflow_storm->scale_storm), "
                 "sentinel remediation fires through the "
                 "recover/requeue/drain contract with the post-remediation "
                 "stream token-parity clean, and seeded simulation alert "
                 "streams are byte-identical")
-    passed = False
+    passed = True
     detail = {}
-    from gradaccum_tpu.obs.trace import Tracer
-    from gradaccum_tpu.obs.trace import installed as tracer_installed
-
-    try:
-        # ONE seeded schedule for every phase, drawn before anything runs
-        plan = draw_plan(args.seed)
-        detail["plan"] = dict(plan)
-        log(f"[chaos] unified plan: {plan}")
-        # one unbounded tracer across all phases: every fault, recover,
-        # resume and request lands on a single correlated timeline, and
-        # nothing is ring-evicted before the assertions read it back
-        with tracer_installed(Tracer(capacity=None)):
-            with tempfile.TemporaryDirectory() as work:
-                detail["train"] = _train_chaos(args.seed, work, log, plan)
-            detail["serve"] = _serve_chaos(args.seed, log, plan)
-            detail["paged"] = _paged_chaos(args.seed, log, plan)
-            detail["ops"] = _ops_chaos(args.seed, log)
-        passed = True
-    except AssertionError as e:
-        log(f"[chaos] FAIL: {e}")
+    seeds = list(range(args.seed, args.seed + max(1, args.seed_range)))
+    per_seed = {}
+    for seed in seeds:
+        try:
+            per_seed[seed] = run_one(seed, log)
+        except AssertionError as e:
+            log(f"[chaos] FAIL (seed {seed}): {e}")
+            per_seed[seed] = {"failed": str(e)}
+            passed = False
+    # single-seed runs keep the historical artifact shape (test_chaos and
+    # dashboards read detail.train / detail.serve / ... directly); a
+    # sweep nests every seed under per_seed alongside the first seed's
+    # phases
+    detail.update(per_seed[seeds[0]])
+    if len(seeds) > 1:
+        detail["per_seed"] = {str(s): d for s, d in per_seed.items()}
 
     artifact = {
         "bench": "seeded chaos smoke (train + serve, CPU)",
         "seed": args.seed,
+        "seeds": seeds,
         "detail": detail,
         "acceptance": {"required": required, "passed": passed},
     }
