@@ -638,7 +638,10 @@ def prefill_paged(params, cfg: GPTConfig, prompt_ids, prompt_lens,
     ``page_rows``, which in this mode hold the SUFFIX region's pages only:
     the shared region is structurally unwritable (its pages simply are not
     in the scatter index). Page alignment of ``start_lens`` makes suffix
-    chunk j land at page ``start_pages + j`` with zero offset skew.
+    chunk j land at page ``start_pages + j`` with zero offset skew —
+    sub-page (copy-on-write) boundaries go through
+    :func:`prefill_paged_cow`, whose per-position writes need no
+    alignment.
     """
     b, s0 = prompt_ids.shape
     page_size = pool_k.shape[3]
@@ -672,6 +675,65 @@ def prefill_paged(params, cfg: GPTConfig, prompt_ids, prompt_lens,
     return pool_k, pool_v, logits
 
 
+def prefill_paged_cow(params, cfg: GPTConfig, suffix_ids, suffix_lens,
+                      start_lens, write_starts, pool_k, pool_v,
+                      read_tables, write_tables):
+    """Suffix prefill for COPY-ON-WRITE partial-page sharing: the
+    :func:`prefill_paged` suffix mode generalized to NON-page-aligned
+    shared regions, with per-POSITION pool writes instead of page-chunk
+    scatters.
+
+    ``start_lens`` [B] is each row's first recomputed position — the COW
+    boundary ``cow_limit`` (an int32 argument like the decode ``limit``,
+    so one compiled program serves every boundary), page-aligned or not;
+    the shared region ``[0, start_lens[b])`` is gathered from the pool
+    through ``read_tables`` and masked EXACTLY to the boundary, so a
+    shared tail block's free offsets (the owner's later decode writes,
+    or junk a fork copied) never contribute. ``write_starts`` [B] drops
+    writes BELOW it as redundant: a fully shared prompt recomputes its
+    last token for logits while the K/V bytes — bitwise what the shared
+    block already holds — are never stored twice. ``write_tables``
+    [B, max_pages] are the rows' full page-table rows; every surviving
+    position translates through them individually (block, offset), so a
+    write landing mid-page (the forked block's private region) neither
+    needs alignment nor clobbers the copied content below it with the
+    chunk scatter's zero padding. Returns ``(pool_k, pool_v,
+    last_logits)``.
+    """
+    b, s0 = suffix_ids.shape
+    num_blocks, page_size = pool_k.shape[1], pool_k.shape[3]
+    max_pages = write_tables.shape[1]
+    s0_pages = -(-s0 // page_size)  # static ceil
+    out_len = s0_pages * page_size
+    k_stack, v_stack, logits = _prefill_suffix(
+        params, cfg, suffix_ids, suffix_lens, start_lens,
+        pool_k, pool_v, read_tables, out_len,
+    )
+    start_lens = jnp.asarray(start_lens, jnp.int32)
+    write_starts = jnp.asarray(write_starts, jnp.int32)
+    suffix_lens = jnp.asarray(suffix_lens, jnp.int32)
+    # compacted index j = suffix token j at global position start + j;
+    # a position writes iff it is a real token (j < len) at or past the
+    # row's write start — everything else is a dropped sentinel write
+    positions = start_lens[:, None] + jnp.arange(out_len)[None, :]  # [B, T]
+    valid = (jnp.arange(out_len)[None, :] < suffix_lens[:, None]) \
+        & (positions >= write_starts[:, None])
+    page = jnp.minimum(positions // page_size, max_pages - 1)
+    blk = jnp.take_along_axis(write_tables, page, axis=1)  # [B, T]
+    blk = jnp.where(valid, blk, num_blocks)  # out-of-bounds = dropped
+    off = positions % page_size
+    bidx3 = blk[:, None, :]                           # [B, 1, T]
+    hidx3 = jnp.arange(cfg.num_heads)[None, :, None]  # [1, H, 1]
+    oidx3 = off[:, None, :]                           # [B, 1, T]
+    # k_stack/v_stack: [L, B, H, T, hd] — T individual (block, offset)
+    # scatters per row, the verify-step idiom applied to prefill
+    pool_k = pool_k.at[:, bidx3, hidx3, oidx3].set(
+        k_stack.astype(pool_k.dtype))
+    pool_v = pool_v.at[:, bidx3, hidx3, oidx3].set(
+        v_stack.astype(pool_v.dtype))
+    return pool_k, pool_v, logits
+
+
 def _prefill_suffix(params, cfg: GPTConfig, suffix_ids, suffix_lens,
                     start_lens, pool_k, pool_v, read_tables, out_len):
     """The suffix-mode body of :func:`prefill_paged`: run only the unshared
@@ -679,7 +741,11 @@ def _prefill_suffix(params, cfg: GPTConfig, suffix_ids, suffix_lens,
     tail's compacted ``(k_stack, v_stack)`` [L, B, H, out_len, hd] (tail
     position j at index j, zeros past each row's length) plus the last real
     token's next-token logits — exactly the contract the page-chunk scatter
-    and the admission sampler expect.
+    and the admission sampler expect. ``start_lens`` need not be
+    page-aligned: the COW path (:func:`prefill_paged_cow`) passes the
+    sub-page ``cow_limit`` boundary and the prefix mask exposes exactly
+    ``[0, start_lens[b])`` of the gathered pages, partial last page
+    included.
 
     The prefix is gathered ONCE per layer from the pool INPUT arrays, so
     within this program reads see only pages written by earlier dispatches

@@ -15,7 +15,11 @@ in flight while every shape stays static). Paged blocks are refcounted, so
 ``Engine(prefix_cache=True)`` lets requests with identical prompt prefixes
 map their page tables onto the SAME blocks (``PrefixCache`` hashes
 page-aligned prompt chunks at admission) and prefill only their unshared
-tails. ``Engine(speculate_k=k, draft_params=..., draft_cfg=...)`` cuts the
+tails — including COPY-ON-WRITE partial tails (``cow_tails``, default on):
+the final ``len % page_size`` chunk is shared read-only up to a recorded
+``cow_limit`` and forked into a private page only at the first write past
+it, and every re-prefill RESUME re-adopts live chunks instead of
+recomputing the whole prompt. ``Engine(speculate_k=k, draft_params=..., draft_cfg=...)`` cuts the
 per-token dispatch bill with SPECULATIVE DECODING: a shallow draft model
 (``models/gpt_decode.truncate_draft_params`` carves one from the target)
 proposes k tokens per slot per cycle and the target scores all k+1

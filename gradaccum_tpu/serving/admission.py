@@ -33,7 +33,14 @@ reserved blocks never fill. This module is the other end of that tradeoff
   PrefixCache` is tomorrow's prefill savings — so victims are ranked by
   (shared + hot cost, fewest reclaimable blocks last). Pinning hot
   prefixes past their last sharer falls out of the same scoring: the
-  slot holding them is never the cheap choice.
+  slot holding them is never the cheap choice. ADOPTED references —
+  full prefix pages and copy-on-write tails — are cheap for their
+  holder (a prefix-aware resume re-adopts them for free) and priced
+  only through the hot term for the sharers left behind.
+- :func:`deadline_victim_cost` — the opt-in ``Engine(victim_score=
+  "deadline")`` ranking: the same primary term, then progress
+  (``generated/max_new``) and queue-wait terms, so a near-finished or
+  long-suffering request is not the default victim.
 """
 
 from __future__ import annotations
@@ -201,16 +208,23 @@ def resolve_policy(admission) -> Optional[AdmissionPolicy]:
 
 def victim_cost(pool, slot: int, prefix_cache) -> tuple:
     """Preemption cost of evicting ``slot``, lower = cheaper. Primary term:
-    blocks other slots share (freed by preempting NO single sharer) plus
-    blocks live in the prefix cache (tomorrow's prefill savings — evicting
-    their holder un-pins a hot prefix). Secondary: prefer the victim that
+    blocks this slot ALLOCATED that other slots share (freed by preempting
+    NO single sharer, and this slot is what keeps them reservation-covered)
+    plus blocks live in the prefix cache (tomorrow's prefill savings —
+    evicting their holder un-pins a hot prefix). Blocks the slot merely
+    ADOPTED (refcount > 1, owned elsewhere — full prefix pages and COW
+    tails alike) cost nothing extra: dropping an adopted reference frees
+    no memory but harms no one either, and a prefix-aware resume simply
+    re-adopts them — cheap for the holder, priced only through the hot
+    term for everyone still sharing. Secondary: prefer the victim that
     returns the MOST private blocks, so one preemption resolves the
     pressure. Ties break on slot index for determinism."""
     shared = hot = freeable = 0
     for b in pool.blocks_of(slot):
         refs = pool.refcount(b)
         if refs > 1:
-            shared += 1
+            if pool.owner_of(b) == slot:
+                shared += 1
         else:
             freeable += 1
         if prefix_cache is not None and prefix_cache.is_live(b):
@@ -218,20 +232,43 @@ def victim_cost(pool, slot: int, prefix_cache) -> tuple:
     return (2 * shared + hot, -freeable, slot)
 
 
+def deadline_victim_cost(pool, slot: int, prefix_cache, *,
+                         progress: float, waited: int) -> tuple:
+    """The deadline/SLO-aware scorer behind ``Engine(victim_score=
+    "deadline")``: the stock refcount/prefix-liveness primary term, then
+    PROGRESS (``generated / max_new`` — a request about to finish frees
+    its blocks on its own in a moment, and evicting it wastes the most
+    completed work) and QUEUE-WAIT (a request that already waited long —
+    or was already preempted once — should not be the default victim
+    again), then the stock most-freeable tiebreak. All terms are small
+    deterministic ints, so seeded simulations pick identical victims
+    across runs."""
+    base = victim_cost(pool, slot, prefix_cache)
+    progress_term = int(round(8 * min(max(float(progress), 0.0), 1.0)))
+    wait_term = min(int(waited) // 8, 8)
+    return (base[0], progress_term + wait_term) + base[1:]
+
+
 def pick_victim(pool, candidates: Sequence[int], prefix_cache,
-                exclude: Optional[int] = None) -> Optional[int]:
+                exclude: Optional[int] = None,
+                score=None) -> Optional[int]:
     """Cheapest victim among ``candidates`` (active slots), or None when
     no candidate would actually free a block (a victim whose every page is
-    shared frees nothing — evicting it is pure loss)."""
+    shared frees nothing — evicting it is pure loss). ``score`` swaps the
+    cost function (``score(slot) -> tuple``, e.g. the engine's
+    deadline-aware closure); the nothing-reclaimable skip is enforced
+    HERE, independent of the scorer, so no scoring policy can pick a
+    victim whose eviction frees no memory."""
     best: Optional[int] = None
     best_cost: Optional[tuple] = None
     for slot in candidates:
         slot = int(slot)
         if slot == exclude:
             continue
-        cost = victim_cost(pool, slot, prefix_cache)
-        if cost[1] == 0:  # -freeable == 0: nothing reclaimable
-            continue
-        if best_cost is None or cost < best_cost:
+        if not any(pool.refcount(b) == 1 for b in pool.blocks_of(slot)):
+            continue  # nothing reclaimable: eviction is pure loss
+        cost = (victim_cost(pool, slot, prefix_cache) if score is None
+                else tuple(score(slot)))
+        if best_cost is None or (cost, slot) < (best_cost, best):
             best, best_cost = slot, cost
     return best

@@ -34,19 +34,27 @@ Blocks are REFCOUNTED so requests with identical prompt prefixes can map
 their page-table entries to the SAME blocks (:class:`PrefixCache` is the
 index that finds them): a block is freed only when its refcount hits zero,
 so a sharer retiring early — EOS, cancel, fault recovery — never yanks
-pages out from under the other users. Prefix pages are read-only once
-written (every writer's pages start strictly after its shared region), so
-there is no copy-on-write. Reservation accounting stays truthful under
-sharing via ORPHAN tracking: a live shared block is covered either by its
-allocating slot's reservation or — once that slot releases — by the orphan
-count, so ``unreserved_blocks`` never promises memory that shared survivors
-are still holding.
+pages out from under the other users. FULL prefix pages are read-only once
+written (every writer's pages start strictly after its shared region).
+PARTIAL tail pages are shared copy-on-write: the index also hashes the
+prompt's final sub-page chunk (:meth:`PrefixCache.insert_tail` /
+:meth:`PrefixCache.match_cow`), a sharer adopts the tail block read-only
+up to its matched token count (the engine's ``cow_limit``; readers mask
+positions past it, so the owner decoding into the block's free tail is
+invisible), and the FIRST write a sharer aims into that page forks the
+block (:meth:`PagedCachePool.fork_cow`: one fresh private block, a
+one-block device copy, the page-table entry rewritten) — closing the
+``len % page_size`` duplication every sharer used to pay. Reservation
+accounting stays truthful under sharing via ORPHAN tracking: a live shared
+block is covered either by its allocating slot's reservation or — once
+that slot releases — by the orphan count, so ``unreserved_blocks`` never
+promises memory that shared survivors are still holding.
 """
 
 from __future__ import annotations
 
 import hashlib
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -182,17 +190,46 @@ class PrefixCache:
     index can never hand out a recycled page. No entry ever outlives its
     block: sharing happens between temporally overlapping requests, and an
     idle pool implies an empty index.
+
+    With ``cow=True`` (copy-on-write tails) the index ALSO hashes the
+    prompt's final PARTIAL chunk at every token length
+    (:meth:`insert_tail`): a later prompt whose content matches one of
+    those sub-page prefixes adopts the same block read-only up to the
+    matched token count (:meth:`match_cow` returns it as the tail), and
+    the engine forks the block before the sharer's first write into that
+    page. Tail entries obey the same lifetime rule — forgotten when their
+    block frees — plus :meth:`trim_tail` for the fork-elision case where
+    a sole surviving sharer takes ownership and will overwrite content
+    past its own matched extent.
     """
 
-    def __init__(self, page_size: int):
+    def __init__(self, page_size: int, cow: bool = True):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         self.page_size = int(page_size)
+        self.cow = bool(cow)
         self._by_hash: Dict[str, int] = {}   # chunk hash -> block id
         self._by_block: Dict[int, str] = {}  # block id -> its chunk hash
+        # partial-tail entries: hash of prompt[:full*P + t] -> [(block,
+        # t), ...]. Unlike full chunks (whose shared block outlives any
+        # single holder by refcount), the SAME sub-page content lives in
+        # many PRIVATE blocks (every fork copies it) — so each hash keeps
+        # every live backing block, first-registered first, and losing
+        # one holder never loses the entry while another block still
+        # carries the bytes. One block can back MANY tail lengths, so
+        # the reverse map holds the keys it appears under.
+        self._tail_by_hash: Dict[str, List[Tuple[int, int]]] = {}
+        self._tail_by_block: Dict[int, List[str]] = {}
 
     def __len__(self) -> int:
+        # full-chunk entries only: the operator's "indexed chunks" gauge
+        # (tail entries are a sub-page refinement, counted separately)
         return len(self._by_hash)
+
+    @property
+    def tail_count(self) -> int:
+        """Live sub-page (copy-on-write) tail entries."""
+        return len(self._tail_by_hash)
 
     def _keys(self, prompt: np.ndarray, n_chunks: int):
         """Yield the first ``n_chunks`` cumulative chunk keys in ONE pass:
@@ -230,32 +267,187 @@ class PrefixCache:
         are skipped — the first writer's block stays canonical, so two
         same-prefix requests admitted in one batch (which cannot share: the
         index is consulted before their joint prefill dispatch) don't
-        thrash the entry."""
+        thrash the entry.
+
+        With ``cow`` on, every SUB-PAGE prefix of each chunk is indexed
+        too (radix-style: one running sha1, one snapshot per token): the
+        boundary between a shared system prompt and a request's unique
+        tail almost never lands on a page edge, so the page holding it is
+        a full page of THIS prompt but only a partial match for the next
+        — exactly what :meth:`match_cow`'s tail walk looks up. Costs one
+        digest per prompt token at insert; entries share their block's
+        lifetime like everything else here."""
         prompt = np.asarray(prompt).reshape(-1)
-        for block, key in zip(blocks, self._keys(prompt, len(blocks))):
+        data = np.ascontiguousarray(prompt, np.int32)
+        h = hashlib.sha1()
+        for chunk, block in enumerate(blocks):
+            block = int(block)
+            base = chunk * self.page_size
+            if self.cow:
+                # probe the full-chunk key first: an already-canonical
+                # chunk (an adopted shared prefix — the common case for a
+                # hot system prompt's followers) registered its sub-page
+                # entries when first inserted, so skipping the per-token
+                # walk keeps insert O(new tokens), not O(prompt)
+                probe = h.copy()
+                probe.update(data[base:base + self.page_size].tobytes())
+                if probe.hexdigest() in self._by_hash:
+                    h = probe
+                    continue
+                for t in range(1, self.page_size):
+                    h.update(data[base + t - 1:base + t].tobytes())
+                    self._tail_register(h.copy().hexdigest(), block, t)
+                h.update(data[base + self.page_size - 1:
+                              base + self.page_size].tobytes())
+            else:
+                h.update(data[base:base + self.page_size].tobytes())
+            key = h.copy().hexdigest()
             if key in self._by_hash:
                 continue
-            block = int(block)
             self._by_hash[key] = block
             self._by_block[block] = key
 
+    def _tail_register(self, key: str, block: int, t: int) -> None:
+        """One sub-page entry: ``key`` (cumulative hash of the prompt's
+        first ``page*P + t`` tokens) is backed by ``block`` holding those
+        ``t`` tokens at its head. Every live backing block registers —
+        the same bytes live in many private forks, and the entry must
+        survive any single holder's retirement."""
+        block = int(block)
+        pairs = self._tail_by_hash.setdefault(key, [])
+        if any(p[0] == block for p in pairs):
+            return
+        pairs.append((block, t))
+        self._tail_by_block.setdefault(block, []).append(key)
+
+    def insert_tail(self, prompt: np.ndarray, block: int) -> None:
+        """Register the prompt's FINAL partial chunk as backed by
+        ``block``: one entry per tail length ``t`` in ``[1, len % P]``,
+        each keyed by the cumulative hash of ``prompt[:full*P + t]`` — so
+        a later prompt sharing any sub-page prefix of this tail finds the
+        longest length its content matches.
+        No-op when ``cow`` is off or the prompt is page-aligned (the full
+        chunk index already covers it). First writer stays canonical,
+        like :meth:`insert`."""
+        if not self.cow:
+            return
+        prompt = np.asarray(prompt).reshape(-1)
+        rem = prompt.size % self.page_size
+        if rem == 0:
+            return
+        block = int(block)
+        full = prompt.size // self.page_size
+        data = np.ascontiguousarray(prompt, np.int32)
+        h = hashlib.sha1()
+        h.update(data[:full * self.page_size].tobytes())
+        for t in range(1, rem + 1):
+            h.update(data[full * self.page_size + t - 1:
+                          full * self.page_size + t].tobytes())
+            self._tail_register(h.copy().hexdigest(), block, t)
+
+    def match_cow(self, prompt: np.ndarray
+                  ) -> Tuple[List[int], Optional[int], int]:
+        """COW-aware lookup: ``(full_blocks, tail_block, tail_tokens)``.
+
+        ``full_blocks`` are the page-aligned chunks matched (UNCLAMPED —
+        a fully page-aligned identical prompt may share every one of its
+        pages; the engine recomputes the last token's logits with its
+        redundant write dropped, so nothing is ever stored twice).
+        ``tail_block``/``tail_tokens`` name the longest indexed sub-page
+        continuation, 0/None when the walk ends on a page boundary. Total
+        shared tokens ``len(full)*P + tail_tokens`` never exceeds the
+        prompt length. With ``cow=False`` this degrades to exactly
+        :meth:`match` (clamped, no tail)."""
+        prompt = np.asarray(prompt).reshape(-1)
+        if not self.cow:
+            return self.match(prompt), None, 0
+        limit = prompt.size // self.page_size
+        blocks: List[int] = []
+        data = np.ascontiguousarray(prompt, np.int32)
+        h = hashlib.sha1()
+        for chunk in range(limit):
+            h.update(data[chunk * self.page_size:
+                          (chunk + 1) * self.page_size].tobytes())
+            block = self._by_hash.get(h.copy().hexdigest())
+            if block is None:
+                # rewind: the tail walk continues from the last full match
+                h = hashlib.sha1()
+                h.update(data[:chunk * self.page_size].tobytes())
+                break
+            blocks.append(block)
+        full = len(blocks)
+        start = full * self.page_size
+        rem = min(self.page_size - 1, prompt.size - start)
+        tail_block: Optional[int] = None
+        tail_tokens = 0
+        for t in range(1, rem + 1):
+            h.update(data[start + t - 1:start + t].tobytes())
+            hit = self._tail_by_hash.get(h.copy().hexdigest())
+            if hit:
+                tail_block, tail_tokens = hit[0][0], t
+        return blocks, tail_block, tail_tokens
+
     def is_live(self, block: int) -> bool:
-        """Whether ``block`` currently backs an indexed prompt chunk — the
-        victim policy's "hot prefix" signal (evicting its holder forfeits
-        future prefill savings, so such a slot is never the cheap
-        victim)."""
+        """Whether ``block`` currently backs an indexed FULL prompt chunk
+        — the victim policy's "hot prefix" signal (evicting its holder
+        forfeits future prefill savings, so such a slot is never the
+        cheap victim). Deliberately ignores sub-page tail entries: with
+        COW on, every prompt page of every admission carries tail
+        entries, so counting them would inflate the hot term uniformly
+        and stop it distinguishing anything."""
         return int(block) in self._by_block
 
     def forget_block(self, block: int) -> None:
-        """Drop the entry backed by ``block`` (the pool calls this when the
-        block's refcount hits zero — its contents are about to be reused)."""
+        """Drop the entries backed by ``block`` — full chunk and every
+        tail length alike (the pool calls this when the block's refcount
+        hits zero — its contents are about to be reused)."""
         key = self._by_block.pop(int(block), None)
         if key is not None:
             self._by_hash.pop(key, None)
+        for key in self._tail_by_block.pop(int(block), []):
+            pairs = self._tail_by_hash.get(key)
+            if pairs is None:
+                continue
+            pairs[:] = [p for p in pairs if p[0] != int(block)]
+            if not pairs:
+                self._tail_by_hash.pop(key, None)
+
+    def trim_tail(self, block: int, max_tokens: int) -> None:
+        """Drop every entry of ``block`` that covers MORE than
+        ``max_tokens`` of it — the fork-elision path: a sole surviving
+        sharer takes ownership of the block and will overwrite content
+        past its own matched extent, so any longer entry would index
+        bytes about to change. That includes the block's FULL-CHUNK entry
+        (it covers the whole page): without dropping it, a later
+        identical prompt would full-chunk-match the page and adopt the
+        new owner's decode writes as prompt K/V."""
+        if int(max_tokens) < self.page_size:
+            key = self._by_block.pop(int(block), None)
+            if key is not None:
+                self._by_hash.pop(key, None)
+        keys = self._tail_by_block.get(int(block))
+        if not keys:
+            return
+        keep = []
+        for key in keys:
+            pairs = self._tail_by_hash[key]
+            mine = next(p for p in pairs if p[0] == int(block))
+            if mine[1] > int(max_tokens):
+                pairs.remove(mine)
+                if not pairs:
+                    self._tail_by_hash.pop(key, None)
+            else:
+                keep.append(key)
+        if keep:
+            self._tail_by_block[int(block)] = keep
+        else:
+            self._tail_by_block.pop(int(block), None)
 
     def clear(self) -> None:
         self._by_hash.clear()
         self._by_block.clear()
+        self._tail_by_hash.clear()
+        self._tail_by_block.clear()
 
 
 class PagedCachePool(_SlotLedger):
@@ -333,6 +525,36 @@ class PagedCachePool(_SlotLedger):
         # tripping the impossible-by-construction invariant
         self.allow_overcommit = False
 
+    def _decref(self, block: int, slot: int) -> bool:
+        """Drop one reference ``slot`` holds on ``block``; returns True
+        when the block hit zero and must be freed (the caller batches the
+        free-list append + index invalidation). Shared-count, orphan, and
+        owner bookkeeping all live here so ``release`` and ``fork_cow``
+        can never drift apart."""
+        if self._block_refs[block] == 2:
+            self._shared_count -= 1  # dropping to a single user
+        self._block_refs[block] -= 1
+        if self._block_refs[block] == 0:
+            if self._block_owner[block] is None:
+                self._orphans -= 1  # was orphaned; now truly free
+            self._block_owner[block] = None
+            return True
+        if self._block_owner[block] == slot:
+            # sharers outlive the allocator: no reservation covers this
+            # block any more, so count it explicitly
+            self._block_owner[block] = None
+            self._orphans += 1
+        return False
+
+    def _reclaim(self, blocks: List[int]) -> None:
+        if not blocks:
+            return
+        self._free_blocks.extend(blocks)
+        self._free_blocks.sort(reverse=True)  # deterministic: lowest block next
+        if self.prefix_cache is not None:
+            for block in blocks:
+                self.prefix_cache.forget_block(block)
+
     def release(self, slot: int) -> None:
         """Free the slot, DECREF its blocks (freeing only those that hit
         zero — shared blocks survive for their other users) and reclaim its
@@ -340,26 +562,9 @@ class PagedCachePool(_SlotLedger):
         become orphans: alive, charged against ``unreserved_blocks``, freed
         when the last sharer releases."""
         self._release_slot(slot)
-        freed = []
-        for block in self._slot_blocks[slot]:
-            if self._block_refs[block] == 2:
-                self._shared_count -= 1  # dropping to a single user
-            self._block_refs[block] -= 1
-            if self._block_refs[block] == 0:
-                if self._block_owner[block] is None:
-                    self._orphans -= 1  # was orphaned; now truly free
-                self._block_owner[block] = None
-                freed.append(block)
-            elif self._block_owner[block] == slot:
-                # sharers outlive the allocator: no reservation covers this
-                # block any more, so count it explicitly
-                self._block_owner[block] = None
-                self._orphans += 1
-        self._free_blocks.extend(freed)
-        self._free_blocks.sort(reverse=True)  # deterministic: lowest block next
-        if self.prefix_cache is not None:
-            for block in freed:
-                self.prefix_cache.forget_block(block)
+        freed = [block for block in self._slot_blocks[slot]
+                 if self._decref(block, slot)]
+        self._reclaim(freed)
         self._slot_blocks[slot] = []
         self._slot_shared[slot] = 0
         self.page_table[slot, :] = self.num_blocks
@@ -496,6 +701,50 @@ class PagedCachePool(_SlotLedger):
             self.page_table[slot, page] = block
         if need > have:
             self._table_device = None
+
+    def fork_cow(self, slot: int, page: int) -> Optional[int]:
+        """Copy-on-write fork of the slot's ADOPTED block at page index
+        ``page``: claim a fresh private block (refcount 1, owned by this
+        slot — covered by the slot's reservation, which never counted the
+        adopted tail as shared), rewrite the page-table entry, and drop
+        the reference on the shared block. Returns the OLD block id so
+        the engine can stage the one-block device copy into the new
+        entry, or None when the fork was ELIDED: this slot was the
+        block's last reference, so it simply takes ownership in place —
+        no copy, no new block (the engine then trims tail-index entries
+        past its own extent, since its writes will overwrite that
+        content). Raises :class:`PoolPressure` when the free list is dry
+        under overcommit, exactly like :meth:`alloc_to`."""
+        if not self._claimed[slot]:
+            raise ValueError(f"slot {slot} is not claimed")
+        old = int(self.page_table[slot, page])
+        if not 0 <= old < self.num_blocks or self._block_refs[old] < 1:
+            raise ValueError(f"slot {slot} page {page} maps no live block")
+        if self._slot_blocks[slot][page] != old:
+            raise ValueError("page-table/slot-blocks mirror out of sync")
+        if self._block_refs[old] == 1 and self._block_owner[old] != slot:
+            # fork elision: every other sharer is gone — adopt the block
+            # outright instead of copying it to a twin
+            if self._block_owner[old] is None:
+                self._orphans -= 1  # now covered by this slot's reservation
+            self._block_owner[old] = slot
+            self._slot_shared[slot] -= 1
+            return None
+        if not self._free_blocks:
+            # only reachable under overcommit (the reservation charged the
+            # fork block as private); the engine preempts a victim and
+            # retries, same as on-demand growth
+            raise PoolPressure(slot, 1, 0, self._slot_reserved[slot])
+        new = self._free_blocks.pop()
+        self._block_refs[new] = 1
+        self._block_owner[new] = slot
+        self._slot_blocks[slot][page] = new
+        self.page_table[slot, page] = new
+        self._table_device = None
+        self._slot_shared[slot] -= 1
+        if self._decref(old, slot):
+            self._reclaim([old])
+        return old
 
     def page_table_device(self) -> jnp.ndarray:
         """Device copy of the page table, memoized: re-uploaded only after

@@ -45,6 +45,7 @@ from gradaccum_tpu.models.gpt_decode import (
     init_cache,
     prefill,
     prefill_paged,
+    prefill_paged_cow,
     sample_token,
     scatter_blocks,
     verify_step_paged,
@@ -244,6 +245,72 @@ def _make_prefix_admit_fn(cfg: GPTConfig, temperature: float, top_k):
         return k, v, lengths, cur_tok, gen_count, rngs, limit, tok0
 
     return jax.jit(admit, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
+
+
+def _make_cow_admit_fn(cfg: GPTConfig, temperature: float, top_k):
+    """The copy-on-write twin of :func:`_make_prefix_admit_fn`:
+    ``start_lens`` carries each row's run boundary (page-aligned or not —
+    sub-page COW boundaries included), ``write_starts`` drops redundant
+    writes below the shared extent (a fully shared prompt recomputes its
+    last token's logits without storing its K/V twice), and
+    ``write_tables`` routes every surviving suffix position through the
+    row's full page table individually, so a write landing mid-page (the
+    forked block's private region) needs no chunk alignment. One program
+    family per (batch, suffix-bucket, prefix-pages-bucket) — the same
+    bound as the aligned prefix program it replaces."""
+
+    def admit(params, k, v, lengths, cur_tok, gen_count, rngs, limit,
+              ids, suffix_lens, start_lens, write_starts, slots, keys,
+              read_tables, write_tables, limits):
+        k, v, logits = prefill_paged_cow(params, cfg, ids, suffix_lens,
+                                         start_lens, write_starts, k, v,
+                                         read_tables, write_tables)
+
+        def pick(lg, key):
+            return sample_token(lg, key, 0, temperature, top_k)
+
+        tok0 = jax.vmap(pick)(logits, keys).astype(jnp.int32)
+        lengths = lengths.at[slots].set(start_lens + suffix_lens)
+        cur_tok = cur_tok.at[slots].set(tok0)
+        gen_count = gen_count.at[slots].set(1)
+        rngs = rngs.at[slots].set(keys)
+        limit = limit.at[slots].set(limits)
+        return k, v, lengths, cur_tok, gen_count, rngs, limit, tok0
+
+    return jax.jit(admit, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
+
+
+def _make_spec_cow_admit_fn(cfg: GPTConfig, draft_cfg: GPTConfig,
+                            temperature: float, top_k, max_len: int):
+    """COW prefix admission + draft prefill: the target side is
+    :func:`_make_cow_admit_fn`'s position-wise suffix write; the draft
+    (fixed layout, no shared blocks) prefills the FULL prompt exactly as
+    in the aligned spec-prefix program."""
+
+    def admit(params, draft_params, k, v, lengths, dk, dv, cur_tok,
+              gen_count, rngs, limit, ids, suffix_lens, start_lens,
+              write_starts, slots, keys, read_tables, write_tables,
+              limits, full_ids, full_lens):
+        k, v, logits = prefill_paged_cow(params, cfg, ids, suffix_lens,
+                                         start_lens, write_starts, k, v,
+                                         read_tables, write_tables)
+        dcache, _ = prefill(draft_params, draft_cfg, full_ids, max_len,
+                            lengths=full_lens)
+
+        def pick(lg, key):
+            return sample_token(lg, key, 0, temperature, top_k)
+
+        tok0 = jax.vmap(pick)(logits, keys).astype(jnp.int32)
+        dk = dk.at[:, slots].set(dcache.k.astype(dk.dtype))
+        dv = dv.at[:, slots].set(dcache.v.astype(dv.dtype))
+        lengths = lengths.at[slots].set(start_lens + suffix_lens)
+        cur_tok = cur_tok.at[slots].set(tok0)
+        gen_count = gen_count.at[slots].set(1)
+        rngs = rngs.at[slots].set(keys)
+        limit = limit.at[slots].set(limits)
+        return k, v, lengths, dk, dv, cur_tok, gen_count, rngs, limit, tok0
+
+    return jax.jit(admit, donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
 
 
 def _make_spec_tick_fn(cfg: GPTConfig, draft_cfg: GPTConfig,
@@ -554,6 +621,23 @@ class Engine:
     prefill per request; outputs are token-for-token unchanged (the parity
     gate in tests/test_serving_prefix.py).
 
+    ``cow_tails`` (default True, prefix mode only) extends sharing BELOW
+    page granularity: the prefix cache also hashes the prompt's final
+    partial chunk, a matching request adopts that tail block READ-ONLY
+    with a recorded ``cow_limit``, and the first write that would land
+    past the limit inside that page FORKS the block (one-block
+    gather→scatter copy into a private page, the page-table entry
+    rewritten — or elided outright when the sharer is the last
+    reference). A 1000-token system prompt at ``page_size=64`` then
+    shares all 16 blocks across N streams instead of 15 plus N private
+    tails, and admission recomputes at most the last prompt token instead
+    of the whole ``len % page_size`` remainder. The same plumbing makes
+    every RESUME prefix-aware: a re-prefill resume (swap="recompute", or
+    any swap degrade) re-adopts the live chunks of prompt + generated —
+    COW tails included — and recomputes only the suffix. Outputs stay
+    token-for-token identical to a non-COW engine (the ``cow`` parity
+    gates).
+
     ``admission`` (paged mode for the overcommitting modes) replaces the
     worst-case reservation gate with an
     :class:`~gradaccum_tpu.serving.admission.AdmissionPolicy` (or one of
@@ -590,6 +674,8 @@ class Engine:
         page_size: Optional[int] = None,
         num_blocks: Optional[int] = None,
         prefix_cache=None,
+        cow_tails: bool = True,
+        victim_score=None,
         scheduler: Optional[Scheduler] = None,
         metrics: Optional[ServingMetrics] = None,
         min_prefill_bucket: int = 8,
@@ -712,9 +798,15 @@ class Engine:
         if self.paged:
             if isinstance(prefix_cache, PrefixCache):
                 self.prefix_cache: Optional[PrefixCache] = prefix_cache
+                # an injected cache's own cow flag wins — the engine must
+                # not adopt partial tails an index refuses to serve
+                self.cow_tails = bool(cow_tails) and bool(prefix_cache.cow)
             else:
-                self.prefix_cache = (PrefixCache(self.page_size)
-                                     if wants_prefix else None)
+                self.prefix_cache = (
+                    PrefixCache(self.page_size, cow=bool(cow_tails))
+                    if wants_prefix else None
+                )
+                self.cow_tails = bool(cow_tails) and wants_prefix
             if num_blocks is None:
                 # equal bytes to the fixed pool by default
                 num_blocks = num_slots * max_len // self.page_size
@@ -728,9 +820,21 @@ class Engine:
                 self.pool.allow_overcommit = True
         else:
             self.prefix_cache = None
+            self.cow_tails = False
             self.num_blocks = None
             self.pool = CachePool(cfg, num_slots, max_len,
                                   cache_dtype=cache_dtype)
+        # deadline-aware victim scoring knob: None keeps the stock
+        # refcount/prefix-liveness cost, "deadline" adds progress and
+        # queue-wait terms, a callable(engine, slot) supplies its own
+        # deterministic cost tuple
+        if not (victim_score is None or victim_score == "deadline"
+                or callable(victim_score)):
+            raise ValueError(
+                f"victim_score must be None, 'deadline', or a callable; "
+                f"got {victim_score!r}"
+            )
+        self.victim_score = victim_score
         # the draft model's OWN KV cache: fixed-slot layout regardless of
         # the target pool kind (shallow × small — paging it would add page
         # bookkeeping for bytes the draft doesn't have), narrowed by the
@@ -793,9 +897,11 @@ class Engine:
             self._obs_args["mesh"] = ",".join(
                 f"{n}={mesh.shape[n]}" for n in mesh.axis_names
             )
-        # prefix matches found by this tick's admission gate, consumed by
-        # _admit (request_id -> shared block ids)
-        self._pending_match: Dict[int, List[int]] = {}
+        # prefix matches found by this tick's admission gate (or a
+        # prefix-aware resume), consumed by _admit: request_id ->
+        # (full shared block ids, cow tail block, cow tail tokens)
+        self._pending_match: Dict[int, Tuple[List[int], Optional[int],
+                                             int]] = {}
         # memoized head match for _bottleneck's diagnostic (request_id,
         # shared blocks) — a rejected submit storm must not re-hash the
         # stalled head's prompt per rejection; mild staleness is fine, the
@@ -839,6 +945,12 @@ class Engine:
         self._limit = jnp.zeros((num_slots,), jnp.int32)
         self._slot_len = np.zeros((num_slots,), np.int64)
         self._slot_limit = np.zeros((num_slots,), np.int64)
+        # copy-on-write state: the absolute shared boundary of a slot's
+        # ADOPTED partial tail block, 0 once forked (or when the slot
+        # never adopted sub-page). Writes at positions past it fork the
+        # block first (_fork_cow); until then the block stays one shared
+        # copy for every sharer.
+        self._slot_cow = np.zeros((num_slots,), np.int64)
         if mesh is not None:
             self._apply_mesh()
 
@@ -873,12 +985,25 @@ class Engine:
         # families, still traffic-independent
         self._prefix_admit_fn = None
         if self.paged and self.prefix_cache is not None:
-            self._prefix_admit_fn = (
-                _make_spec_prefix_admit_fn(cfg, draft_cfg, self.temperature,
-                                           self.top_k, max_len)
-                if self.speculate_k else
-                _make_prefix_admit_fn(cfg, self.temperature, self.top_k)
-            )
+            if self.cow_tails:
+                # the COW family REPLACES the aligned prefix family: one
+                # position-wise program serves aligned and sub-page
+                # boundaries alike, so the two-family count is unchanged
+                self._prefix_admit_fn = (
+                    _make_spec_cow_admit_fn(cfg, draft_cfg,
+                                            self.temperature, self.top_k,
+                                            max_len)
+                    if self.speculate_k else
+                    _make_cow_admit_fn(cfg, self.temperature, self.top_k)
+                )
+            else:
+                self._prefix_admit_fn = (
+                    _make_spec_prefix_admit_fn(cfg, draft_cfg,
+                                               self.temperature,
+                                               self.top_k, max_len)
+                    if self.speculate_k else
+                    _make_prefix_admit_fn(cfg, self.temperature, self.top_k)
+                )
         if self.paged:
             self._admit_fn = (
                 _make_spec_paged_admit_fn(cfg, draft_cfg, self.temperature,
@@ -999,6 +1124,11 @@ class Engine:
             "page_size": self.page_size,
             "num_blocks": self.num_blocks,
             "prefix_cache": self.prefix_cache is not None,
+            "cow_tails": self.cow_tails,
+            "victim_score": (None if self.victim_score is None
+                             else self.victim_score
+                             if isinstance(self.victim_score, str)
+                             else "custom"),
             "temperature": self.temperature,
             "top_k": self.top_k,
             "min_prefill_bucket": self.min_prefill_bucket,
@@ -1243,8 +1373,13 @@ class Engine:
                     # it unreachable in practice) — the generic stall key
                     # stands; "held by quantile gate" would misdirect
                     return False
-                shared = (self.prefix_cache.match(r.prompt)
-                          if self.prefix_cache is not None else [])
+                match = (self.prefix_cache.match_cow(r.prompt)
+                         if self.prefix_cache is not None
+                         else ([], None, 0))
+                # only FULL shared pages reduce the block ask: an adopted
+                # COW tail still needs its fork block the moment the
+                # request writes into that page, so the gate charges it
+                shared = match[0]
                 if policy is None:
                     budget = full
                     need = total - len(shared)
@@ -1269,7 +1404,7 @@ class Engine:
                         stall_override[0] = "held_by_quantile_gate"
                     return False
                 pending[0] += need
-                self._pending_match[r.request_id] = shared
+                self._pending_match[r.request_id] = match
                 self._pending_budget[r.request_id] = budget
                 return True
 
@@ -1425,6 +1560,16 @@ class Engine:
             )
             if self.prefix_cache is not None:
                 gauges["shared_blocks"] = self.pool.shared_blocks
+            if self.cow_tails:
+                # blocks currently shared SUB-PAGE: adopted tails whose
+                # fork hasn't happened yet (distinct blocks — several
+                # slots may ride one tail)
+                gauges["cow_shared_blocks"] = len({
+                    int(self.pool.page_table[
+                        s, int(self._slot_cow[s]) // self.page_size])
+                    for s in range(self.pool.num_slots)
+                    if self._slot_cow[s] > 0
+                })
         else:
             gauges.update(
                 token_capacity=self.pool.num_slots * self.max_len,
@@ -1569,6 +1714,68 @@ class Engine:
                     self.pool._table_device = None
                     break
 
+    def _fork_cow(self, slot: int) -> None:
+        """Copy-on-write fork of the slot's adopted partial tail block,
+        run immediately before its first write past ``cow_limit`` (the
+        suffix prefill for a tailed prompt, the first decode tick for a
+        fully shared one). The pool swaps in a fresh private block and
+        the one-block device copy reuses the PR-12 swap programs
+        (``gather_blocks``/``scatter_blocks``, bucket 1 — bounded
+        compile count); a fork ELIDED by the pool (last reference takes
+        ownership in place) costs nothing but a tail-index trim. Raises
+        :class:`PoolPressure` like any on-demand growth — the caller's
+        victim loop handles it."""
+        pool = self.pool
+        cow = int(self._slot_cow[slot])
+        page = cow // self.page_size
+        old = pool.fork_cow(slot, page)
+        if old is None:
+            # elision: sole survivor took the block over — entries past
+            # our own shared extent index content our writes will replace
+            if self.prefix_cache is not None:
+                self.prefix_cache.trim_tail(
+                    int(pool.page_table[slot, page]), cow % self.page_size)
+            self.metrics.record_cow_fork(elided=True)
+        else:
+            new = int(pool.page_table[slot, page])
+            kb, vb = gather_blocks(pool.k, pool.v,
+                                   np.asarray([old], np.int32))
+            new_k, new_v = scatter_blocks(pool.k, pool.v,
+                                          np.asarray([new], np.int32),
+                                          kb, vb)
+            if self._kv_sharding is not None:
+                new_k = jax.device_put(new_k, self._kv_sharding)
+                new_v = jax.device_put(new_v, self._kv_sharding)
+            pool.set_arrays(new_k, new_v, pool.lengths)
+            self.metrics.record_cow_fork(elided=False)
+        self._slot_cow[slot] = 0
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("serve/cow_fork", cat="serving", tick=self._tick,
+                     slot=slot, elided=old is None, **self._obs_args)
+
+    def _victim_scorer(self):
+        """Resolve the ``victim_score`` knob to a per-slot cost callable
+        for :func:`~gradaccum_tpu.serving.admission.pick_victim` (None =
+        the stock refcount/prefix-liveness cost). Built per pressure
+        event — the rare path — so the closure always sees current
+        progress and waits."""
+        if self.victim_score is None:
+            return None
+        if callable(self.victim_score):
+            return lambda slot: self.victim_score(self, slot)
+
+        def score(slot):
+            req = self._slot_req[slot]
+            done = len(self.results.get(req.request_id, ()))
+            return admission_lib.deadline_victim_cost(
+                self.pool, slot, self.prefix_cache,
+                progress=done / max(req.max_new_tokens, 1),
+                waited=self._tick - req.submit_tick,
+            )
+
+        return score
+
     def _ensure_blocks(self, active_now, advance: int, preempted: List[int],
                        protect=frozenset()):
         """Grow every active slot's page table to this tick's worst-case
@@ -1588,6 +1795,12 @@ class Engine:
                 continue  # taken as a victim earlier in this very loop
             while True:
                 try:
+                    if self._slot_cow[slot]:
+                        # the slot's whole prompt rode shared blocks; its
+                        # first decode write is about to land inside the
+                        # shared tail page — fork now (inside the retry
+                        # loop: the fork block may need a victim too)
+                        self._fork_cow(slot)
                     self.pool.alloc_to(
                         slot,
                         min(self._slot_len[slot] + advance,
@@ -1606,7 +1819,8 @@ class Engine:
                         and s != slot and s not in protect
                     ]
                     victim = admission_lib.pick_victim(
-                        self.pool, candidates, self.prefix_cache)
+                        self.pool, candidates, self.prefix_cache,
+                        score=self._victim_scorer())
                     if victim is None:
                         # no eviction frees a block: the slot skips this
                         # tick's decode and retries next tick
@@ -1740,6 +1954,10 @@ class Engine:
         pool.release(slot)
         self._slot_len[slot] = 0
         self._slot_limit[slot] = 0
+        # an unforked COW adoption is dropped with the slot's other refs;
+        # the resume re-matches the prefix cache and re-adopts whatever
+        # is still live (prefix-aware resume), so nothing is pinned here
+        self._slot_cow[slot] = 0
         self.scheduler.park(req)
         self.status[rid] = "preempted"
         preempted.append(rid)
@@ -1821,20 +2039,28 @@ class Engine:
         pool = self.pool
         tr = self.tracer
         rec = None
-        shared: List[int] = []
+        match: Tuple[List[int], Optional[int], int] = ([], None, 0)
+        ext = None
         if self.paged:
+            # PREFIX-AWARE RESUME: both restore paths re-adopt whatever
+            # of the request's (extended) prompt still lives in the
+            # prefix cache. The match runs against prompt + generated
+            # so-far — the exact token stream a re-prefill recomputes —
+            # so a resume behind surviving sharers pays only the suffix.
+            if self.prefix_cache is not None:
+                prior = np.asarray(self.results[rid][:max(pk.generated - 1,
+                                                          0)], np.int32)
+                ext = np.concatenate([np.asarray(req.prompt, np.int32),
+                                      prior])
+                match = self.prefix_cache.match_cow(ext)
             # swap restore needs the shared head alive: the prefix cache
             # must still map the request's leading prompt chunks onto live
             # blocks (their other sharers kept them); anything short of
             # that discards the swap and re-prefills
             swap_ok = pk.swapped and self._swap_store is not None
             if swap_ok and pk.page_start:
-                if self.prefix_cache is None:
-                    swap_ok = False
-                else:
-                    shared = self.prefix_cache.match(req.prompt)
-                    swap_ok = len(shared) >= pk.page_start
-            adopt = shared[:pk.page_start] if swap_ok else []
+                swap_ok = len(match[0]) >= pk.page_start
+            adopt = match[0][:pk.page_start] if swap_ok else []
 
             def gate(n_adopt):
                 """Anti-thrash reservation check: the FULL remaining worst
@@ -1855,7 +2081,11 @@ class Engine:
                     return None
                 return tokens
 
-            reserve_tokens = gate(len(adopt))
+            # the re-prefill leg discounts only FULL matched pages (a COW
+            # tail's fork block must stay pre-paid), the swap leg exactly
+            # its surviving shared head
+            reserve_tokens = gate(len(adopt) if swap_ok
+                                  else len(match[0]))
             if reserve_tokens is None:
                 return False
             if swap_ok:
@@ -1869,10 +2099,15 @@ class Engine:
                     self.metrics.record_swap_fallback()
                     pk.swapped = False  # later attempts gate as reprefill
                     rec = None
-                if rec is None and adopt:
-                    # the gate assumed adoption; re-prefill adopts nothing
+                if rec is None:
+                    # SWAP-DEGRADE: no block references have been taken
+                    # yet — adoption happens only inside the committed
+                    # restore/dispatch below — so the degraded resume
+                    # re-gates for the re-prefill leg with a clean slate
+                    # and can never leak a COW/shared refcount it took
+                    # for the abandoned swap plan
                     adopt = []
-                    reserve_tokens = gate(0)
+                    reserve_tokens = gate(len(match[0]))
                     if reserve_tokens is None:
                         return False
         elif pk.swapped and self._swap_store is not None:
@@ -1895,7 +2130,8 @@ class Engine:
             kind = "swap_in"
         else:
             self._resume_reprefill(
-                req, pk, reserve_tokens if self.paged else None)
+                req, pk, reserve_tokens if self.paged else None,
+                match=match if self.paged else None, ext=ext)
             kind = "reprefill"
         if self._swap_store is not None:
             self._swap_store.discard(rid)  # consumed (or superseded)
@@ -1978,7 +2214,8 @@ class Engine:
                 self._dkv_sharding)
 
     def _resume_reprefill(self, req: Request, pk: _ParkedState,
-                          reserve_tokens: Optional[int] = None) -> None:
+                          reserve_tokens: Optional[int] = None,
+                          match=None, ext=None) -> None:
         """Recompute a parked request's K/V instead of restoring bytes:
         re-prefill ``prompt + generated[:-1]`` through the NORMAL admit
         program (same compile buckets), then pin the resume point — the
@@ -1987,16 +2224,26 @@ class Engine:
         SAME rng indices an uninterrupted run would have.
         ``reserve_tokens`` is the reservation _resume_one validated — it
         may be LESS than the full worst case under pressure, and the
-        dispatch must reserve exactly what was checked, not re-derive."""
+        dispatch must reserve exactly what was checked, not re-derive.
+        ``match`` is the prefix-cache lookup _resume_one ran against the
+        extended prompt: the dispatch adopts those still-live chunks —
+        full pages AND COW tails — and recomputes only the suffix
+        (prefix-aware resume); None leaves the legacy full re-prefill."""
         rid = req.request_id
         if reserve_tokens is not None:
             # consumed by _admit_dispatch's reserve call, like any
             # policy-budgeted admission
             self._pending_budget[rid] = int(reserve_tokens)
         g = pk.generated
-        prior = np.asarray(self.results[rid][:g - 1], np.int32)
-        ext = np.concatenate([np.asarray(req.prompt, np.int32), prior])
+        if ext is None:
+            prior = np.asarray(self.results[rid][:g - 1], np.int32)
+            ext = np.concatenate([np.asarray(req.prompt, np.int32), prior])
         assert ext.size == pk.length, "resume point drifted from the mirror"
+        if match is not None and (match[0] or match[2]):
+            # consumed by _admit_dispatch exactly like a fresh admission's
+            # fits-gate match — the reservation above was validated
+            # against the same full-page count, so the two stay in step
+            self._pending_match[rid] = match
         synth = Request(
             request_id=rid, prompt=ext,
             max_new_tokens=pk.limit - int(ext.size),
@@ -2065,6 +2312,7 @@ class Engine:
                 self.pool.release(slot)
                 self._slot_len[slot] = 0
                 self._slot_limit[slot] = 0
+                self._slot_cow[slot] = 0
                 self.status[request_id] = "cancelled"
                 self.metrics.record_finish(request_id, "cancelled")
                 ts0 = self._req_admit_ts.pop(request_id, None)
@@ -2104,6 +2352,7 @@ class Engine:
             self._slot_req[slot] = None
             self._active[slot] = False
             self.pool.release(slot)
+            self._slot_cow[slot] = 0
             self.status[req.request_id] = "error"
             # close out the metrics lifecycle too, or the per-request
             # timing entries leak for every faulted request forever
@@ -2145,6 +2394,7 @@ class Engine:
                 self._draft_k, self._draft_v = dcache.k, dcache.v
             self._slot_len[:] = 0
             self._slot_limit[:] = 0
+            self._slot_cow[:] = 0
             if self.mesh is not None:
                 self._apply_mesh()
             rebuilt = True
@@ -2214,11 +2464,22 @@ class Engine:
         prefix = self.paged and self.prefix_cache is not None
         # prefix hits prefill only their unshared tail, so the ids buffer
         # (and its bucket) is sized by the longest TAIL, not prompt
-        matches = {r.request_id: self._pending_match.pop(r.request_id, [])
+        matches = {r.request_id:
+                   self._pending_match.pop(r.request_id, ([], None, 0))
                    for r in reqs} if prefix else {}
-        shared_tok = {rid: len(blocks) * self.page_size
-                      for rid, blocks in matches.items()}
-        tails = [r.prompt.size - shared_tok.get(r.request_id, 0) for r in reqs]
+        # shared_tok = the true shared extent (full pages + cow tail; may
+        # equal the whole prompt — writes below it are redundant and
+        # dropped); run boundaries keep >= 1 trailing token to recompute,
+        # since a request always needs its last prompt token's logits
+        shared_tok = {}
+        run_start = {}
+        for r in reqs:
+            full_m, _, tail_t = matches.get(r.request_id, ([], None, 0))
+            st = len(full_m) * (self.page_size or 0) + tail_t
+            shared_tok[r.request_id] = st
+            run_start[r.request_id] = min(st, r.prompt.size - 1)
+        tails = [r.prompt.size - run_start.get(r.request_id, 0)
+                 for r in reqs]
         s0 = self._bucket_len(max(tails))
         ids = np.zeros((len(reqs), s0), np.int32)
         lens = np.zeros((len(reqs),), np.int32)
@@ -2236,11 +2497,19 @@ class Engine:
             page_rows = np.full((len(reqs), s0_pages), self.pool.num_blocks,
                                 np.int32)
             starts = np.zeros((len(reqs),), np.int32)
+            wstarts = np.zeros((len(reqs),), np.int32)
             # the prefix gather's extent tracks the batch's LARGEST shared
             # region (bucketed to powers of two so the admit program count
             # stays bounded), not max_len — a short shared prefix must not
-            # pay a max_len-wide gather and attention per layer
-            max_shared = max((len(matches.get(r.request_id, ()))
+            # pay a max_len-wide gather and attention per layer. A COW
+            # tail counts as one more page: the gather must reach the
+            # partial block its mask exposes up to the boundary.
+            def _pages_of(m):
+                full_m, _, tail_t = m
+                return len(full_m) + (1 if tail_t else 0)
+
+            max_shared = max((_pages_of(matches.get(r.request_id,
+                                                    ([], None, 0)))
                               for r in reqs), default=0)
             prefix_pages = 1
             while prefix_pages < max_shared:
@@ -2248,28 +2517,48 @@ class Engine:
             prefix_pages = min(prefix_pages, self.pool.max_pages)
             read_tables = np.full((len(reqs), prefix_pages),
                                   self.pool.num_blocks, np.int32)
+            write_tables = np.full((len(reqs), self.pool.max_pages),
+                                   self.pool.num_blocks, np.int32)
             limits = np.zeros((len(reqs),), np.int32)
             for i, (slot, r) in enumerate(zip(slots, reqs)):
-                shared = matches.get(r.request_id, [])
+                full_m, tail_b, tail_t = matches.get(r.request_id,
+                                                     ([], None, 0))
+                shared = full_m + ([tail_b] if tail_t else [])
                 budget = r.prompt.size + r.max_new_tokens
                 # the RESERVATION is the admission policy's budget (the
                 # quantile/optimistic ask the gate admitted on); the write
                 # limit below stays the full worst case — optimism bounds
-                # admission, never what a request may write
+                # admission, never what a request may write. Only FULL
+                # shared pages discount the reservation: an adopted COW
+                # tail's eventual fork block must be pre-paid.
                 self.pool.reserve(slot,
                                   self._pending_budget.pop(r.request_id,
                                                            budget),
-                                  shared_blocks=len(shared))
+                                  shared_blocks=len(full_m))
                 if shared:
                     self.pool.adopt_shared(slot, shared)
+                if tail_t:
+                    cow = len(full_m) * page_size + tail_t
+                    self._slot_cow[slot] = cow
+                    self.metrics.record_cow_adopt(tokens=tail_t)
+                    if r.prompt.size > cow:
+                        # the suffix prefill writes into the shared tail
+                        # page right now — fork before the dispatch (the
+                        # deferred case, a fully shared prompt, forks at
+                        # its first decode write instead)
+                        self._fork_cow(slot)
                 self.pool.alloc_to(slot, r.prompt.size)
-                # write pages: the SUFFIX region only — shared pages are
-                # structurally absent from the scatter index
+                # write pages for the ALIGNED program: the suffix region
+                # only — shared pages are structurally absent from its
+                # chunk-scatter index. The COW program ignores page_rows
+                # and routes positions through the full row instead.
                 n = self.pool.blocks_for(r.prompt.size) - len(shared)
                 page_rows[i, :n] = self.pool.page_table[
                     slot, len(shared):len(shared) + n]
-                starts[i] = len(shared) * page_size
+                starts[i] = run_start[r.request_id]
+                wstarts[i] = shared_tok[r.request_id]
                 read_tables[i] = self.pool.page_table[slot, :prefix_pages]
+                write_tables[i] = self.pool.page_table[slot]
                 limits[i] = budget
                 self._slot_len[slot] = r.prompt.size
                 self._slot_limit[slot] = budget
@@ -2285,9 +2574,16 @@ class Engine:
                         self._rngs, self._limit)
             args = head + (jnp.asarray(ids), jnp.asarray(lens))
             if prefix and starts.any():
-                tail = (jnp.asarray(starts), jnp.asarray(slots, jnp.int32),
-                        keys, jnp.asarray(page_rows),
-                        jnp.asarray(read_tables), jnp.asarray(limits))
+                if self.cow_tails:
+                    tail = (jnp.asarray(starts), jnp.asarray(wstarts),
+                            jnp.asarray(slots, jnp.int32), keys,
+                            jnp.asarray(read_tables),
+                            jnp.asarray(write_tables), jnp.asarray(limits))
+                else:
+                    tail = (jnp.asarray(starts),
+                            jnp.asarray(slots, jnp.int32),
+                            keys, jnp.asarray(page_rows),
+                            jnp.asarray(read_tables), jnp.asarray(limits))
                 if spec:
                     # the draft prefills the FULL prompt: its fixed cache
                     # has no shared blocks to lean on (the target's suffix
@@ -2326,6 +2622,15 @@ class Engine:
                         r.prompt, [int(b) for b in
                                    self.pool.page_table[slot, :full]]
                     )
+                    if self.cow_tails and r.prompt.size % page_size:
+                        # the prompt's final PARTIAL page is indexable
+                        # too: its block (freshly written, or a fork
+                        # whose copied head plus suffix writes equal
+                        # exactly this prompt's tail) serves future
+                        # sub-page matches
+                        self.prefix_cache.insert_tail(
+                            r.prompt,
+                            int(self.pool.page_table[slot, full]))
         else:
             for slot, r in zip(slots, reqs):
                 self._slot_len[slot] = r.prompt.size
@@ -2349,20 +2654,31 @@ class Engine:
                 (k, v, lengths, self._cur_tok, self._gen, self._rngs,
                  tok0) = out
         for i, r in enumerate(reqs):
-            skipped = shared_tok.get(r.request_id, 0)
+            # the prefill bill skips exactly the tokens NOT recomputed —
+            # run_start, which is the shared extent except when the whole
+            # prompt was shared (one trailing token recomputes for logits
+            # with its redundant write dropped)
+            skipped = run_start.get(r.request_id, 0)
             # hit-rate denominator: only admissions that COULD have hit —
-            # a sub-page prompt has no full chunk to match by construction,
-            # and a re-prefill RESUME row never consults the index (its
-            # recomputed tokens are billed, but it must not count as a
-            # second miss against the hit rate)
-            eligible = (prefix and r.prompt.size > self.page_size
+            # a sub-page prompt can still match a COW tail (so cow
+            # engines count it), and a re-prefill RESUME row is billed
+            # but never counted as a second miss against the hit rate
+            eligible = (prefix
+                        and (r.prompt.size > self.page_size
+                             or (self.cow_tails and r.prompt.size > 1))
                         and r.request_id != self._resuming_rid)
-            n_shared = len(matches.get(r.request_id, ()))
+            full_m, _, tail_t = matches.get(r.request_id, ([], None, 0))
+            n_shared = len(full_m) + (1 if tail_t else 0)
             self.metrics.record_admission(
                 computed_tokens=tails[i], skipped_tokens=skipped,
                 shared_blocks=n_shared,
                 prefix_hit=(skipped > 0) if eligible else None,
             )
+            if r.request_id == self._resuming_rid and self.paged:
+                # the prefix-aware resume's bill: tokens the re-prefill
+                # did NOT recompute because live chunks were re-adopted
+                self.metrics.record_resume_prefill(computed=tails[i],
+                                                   saved=skipped)
             if tr.enabled:
                 # block / prefix-cache attribution for this admission
                 tr.event("req/admit", cat="request", rid=r.request_id,
@@ -2413,6 +2729,7 @@ class Engine:
             self._active[slot] = False
             self._slot_req[slot] = None
             self.pool.release(slot)
+            self._slot_cow[slot] = 0
             self.status[rid] = "done"
             finished.append((rid, reason))
             self.metrics.record_finish(rid, reason)

@@ -77,6 +77,18 @@ class ServingMetrics:
         self.prefix_misses = 0
         self.shared_blocks = LatencySeries()  # sampled per tick (prefix mode)
         self.shared_blocks_peak: Optional[int] = None
+        # copy-on-write tails: sub-page adoptions, the fork bill (real
+        # one-block copies vs elided last-reference takeovers), and the
+        # prefix-aware-resume bill (tokens a re-prefill resume did NOT
+        # recompute because live chunks were re-adopted)
+        self.cow_adoptions = 0
+        self.cow_tokens_shared = 0
+        self.cow_forks = 0
+        self.cow_forks_elided = 0
+        self.cow_shared_blocks = LatencySeries()  # sampled per tick
+        self.cow_shared_blocks_peak: Optional[int] = None
+        self.resume_prefill_tokens = 0        # recomputed during resumes
+        self.resume_prefill_tokens_saved = 0  # re-adopted instead
         self._submit_t: Dict[int, float] = {}
         self._last_token_t: Dict[int, float] = {}
         self._admitted: set = set()  # rids whose queue wait is recorded
@@ -105,6 +117,13 @@ class ServingMetrics:
             "serving/prefill_tokens_computed_total", labels=self._labels)
         self._c_prefill_skipped = reg.counter(
             "serving/prefill_tokens_skipped_total", labels=self._labels)
+        self._c_cow_adopt = reg.counter("serving/cow_adoptions_total",
+                                        labels=self._labels)
+        self._c_cow_fork = reg.counter("serving/cow_forks_total",
+                                       labels=self._labels)
+        self._c_resume_saved = reg.counter(
+            "serving/resume_prefill_tokens_saved_total",
+            labels=self._labels)
         # speculative decoding: draft proposals vs target acceptances
         # (cumulative counters for /metrics scrapes, a windowed per-tick
         # fraction for the sentinel's degenerate-draft check)
@@ -235,6 +254,33 @@ class ServingMetrics:
             self.reprefills += 1
             self._c_reprefill.inc()
 
+    def record_cow_adopt(self, tokens: int) -> None:
+        """One sub-page (copy-on-write) tail adoption: ``tokens`` prompt
+        tokens rode an existing partial block instead of being recomputed
+        and stored again."""
+        self.cow_adoptions += 1
+        self.cow_tokens_shared += int(tokens)
+        self._c_cow_adopt.inc()
+
+    def record_cow_fork(self, elided: bool = False) -> None:
+        """One copy-on-write fork: the first write past a shared tail's
+        ``cow_limit`` gave the sharer its private copy (``elided`` = the
+        sharer was the last reference and took the block over with no
+        copy at all)."""
+        self.cow_forks += 1
+        if elided:
+            self.cow_forks_elided += 1
+        self._c_cow_fork.inc()
+
+    def record_resume_prefill(self, computed: int, saved: int) -> None:
+        """One prefix-aware re-prefill resume's bill: ``computed`` tokens
+        ran through the model again, ``saved`` re-adopted live chunks
+        instead (PR-12's resume recomputed everything — this counter is
+        the gap it closed)."""
+        self.resume_prefill_tokens += int(computed)
+        self.resume_prefill_tokens_saved += int(saved)
+        self._c_resume_saved.inc(int(saved))
+
     def record_swap_fallback(self) -> None:
         """A swap record was abandoned (IO error, sha mismatch, capacity
         eviction, or its shared head died) — the request resumes by
@@ -308,6 +354,7 @@ class ServingMetrics:
                     free_blocks: Optional[int] = None,
                     decode_block: Optional[int] = None,
                     shared_blocks: Optional[int] = None,
+                    cow_shared_blocks: Optional[int] = None,
                     parked: Optional[int] = None,
                     preemptions: Optional[int] = None,
                     swap_store_bytes: Optional[int] = None) -> None:
@@ -347,6 +394,12 @@ class ServingMetrics:
                     or shared_blocks > self.shared_blocks_peak):
                 self.shared_blocks_peak = shared_blocks
             scalars["serving/shared_kv_blocks"] = float(shared_blocks)
+        if cow_shared_blocks is not None:
+            self.cow_shared_blocks.add(cow_shared_blocks)
+            if (self.cow_shared_blocks_peak is None
+                    or cow_shared_blocks > self.cow_shared_blocks_peak):
+                self.cow_shared_blocks_peak = cow_shared_blocks
+            scalars["serving/cow_shared_blocks"] = float(cow_shared_blocks)
         if parked is not None:
             if parked > self.parked_peak:
                 self.parked_peak = parked
@@ -403,6 +456,13 @@ class ServingMetrics:
             "blocks_saved": self.blocks_saved,
             "shared_blocks": self.shared_blocks.summary(),
             "shared_blocks_peak": self.shared_blocks_peak,
+            "cow_adoptions": self.cow_adoptions,
+            "cow_tokens_shared": self.cow_tokens_shared,
+            "cow_forks": self.cow_forks,
+            "cow_forks_elided": self.cow_forks_elided,
+            "cow_shared_blocks_peak": self.cow_shared_blocks_peak,
+            "resume_prefill_tokens": self.resume_prefill_tokens,
+            "resume_prefill_tokens_saved": self.resume_prefill_tokens_saved,
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
             "spec_accept_rate": self.spec_accept_rate(),

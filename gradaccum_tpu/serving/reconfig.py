@@ -237,7 +237,11 @@ def _demand_blocks(engine) -> int:
     """The largest single in-flight request's worst-case block need —
     the shrink floor. Parked requests resume strict FIFO (one at a time
     against an otherwise-drainable pool), so the binding constraint is
-    the biggest reservation any one of them will ask for, not the sum."""
+    the biggest reservation any one of them will ask for, not the sum.
+    Deliberately IGNORES prefix/COW sharing: the rebuild clears the
+    prefix cache, so a resumed request must be able to re-prefill with
+    zero adoption — shared and copy-on-write blocks are cheap to drop
+    for their holders exactly because this floor never counted them."""
     pool = engine.pool
     need = 0
     for slot, req in enumerate(engine._slot_req):
@@ -307,6 +311,10 @@ def _pool_resize(engine, spec: ReconfigSpec) -> ReconfigResult:
     engine.num_blocks = nb
     engine._slot_len[:] = 0
     engine._slot_limit[:] = 0
+    # any adopted-but-unforked COW tails died with the old pool's blocks
+    # (the preempt-all above already decref'd them); a resumed request
+    # re-matches the (cleared) prefix cache and re-adopts from scratch
+    engine._slot_cow[:] = 0
     if engine.mesh is not None:
         engine._apply_mesh()
     # the rebuilt table through the SAME upload-time bounds check every

@@ -676,6 +676,19 @@ class ServingServer:
                 "prefill_tokens_skipped":
                     engine.metrics.prefill_tokens_skipped,
             }
+            if getattr(engine, "cow_tails", False):
+                # sub-page sharing: adoptions/forks so far plus what the
+                # prefix-aware resume path saved in re-prefill tokens —
+                # the "is COW earning its keep" view
+                m = engine.metrics
+                out["prefix"]["cow"] = {
+                    "adoptions": m.cow_adoptions,
+                    "tokens_shared": m.cow_tokens_shared,
+                    "forks": m.cow_forks,
+                    "forks_elided": m.cow_forks_elided,
+                    "resume_prefill_tokens_saved":
+                        m.resume_prefill_tokens_saved,
+                }
         policy = getattr(engine, "admission_policy", None)
         if policy is not None:
             # the admission plane's live view: how optimistic the gate is

@@ -51,6 +51,11 @@ class SwapRecord:
     under their refcounts)."""
 
     arrays: Dict[str, np.ndarray]
+    # page_start counts the leading SHARED pages left alive in the pool
+    # for their other users — full prefix pages, and (since COW tails) a
+    # forked tail's private twin is past it while an adopted-but-unforked
+    # tail never reaches the store at all: a slot with no private writes
+    # has nothing to swap, and parks with an empty footprint instead
     page_start: int
     length: int
     digest: str

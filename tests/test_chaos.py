@@ -34,3 +34,35 @@ def test_chaos_smoke_passes_and_refreshes_artifact():
     assert ops["serve"]["fault_to_alert"] == {
         "crash": "engine_fault", "slow_tick": "latency_cliff"}
     assert ops["train"]["drained_at_step"] is not None
+
+
+# Seeds with a KNOWN failing schedule ride here as (seed, "issue #N")
+# pairs until their fix lands — the nightly sweep's triage protocol
+# (.github/workflows/chaos-nightly.yml). Empty today: seeds 1..4 were
+# swept clean when the CI job landed.
+XFAIL_SEEDS: dict = {}
+
+
+def test_chaos_seed_range_sweep(tmp_path):
+    """The nightly job's sweep shape, pinned small for CI: several
+    CONSECUTIVE seeds through the one cross-phase schedule, each
+    deterministic, the artifact recording every seed it covered. A seed
+    listed in XFAIL_SEEDS is expected red (tracked by issue) — any OTHER
+    failure is a real regression."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import json
+
+    import chaos_smoke
+
+    out = tmp_path / "chaos_sweep.json"
+    rc = chaos_smoke.main(["--seed", "1", "--seed-range", "3",
+                           "--json", str(out)])
+    with open(out) as f:
+        artifact = json.load(f)
+    assert artifact["seeds"] == [1, 2, 3]
+    expected_red = {s for s in artifact["seeds"] if s in XFAIL_SEEDS}
+    if expected_red:
+        pytest.xfail(f"known-red seeds {sorted(expected_red)}: "
+                     + ", ".join(XFAIL_SEEDS[s] for s in expected_red))
+    assert rc == 0
+    assert artifact["acceptance"]["passed"] is True

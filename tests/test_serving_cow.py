@@ -1,0 +1,670 @@
+"""Copy-on-write partial pages: sub-page sharing, forks, prefix-aware resume.
+
+The load-bearing gates mirror the prefix/admission suites': COW tails are
+a memory/compute mechanism and must NEVER show in results — greedy AND
+seeded-sampled streams through sub-page adoption, the fork (eager at a
+tailed admission, deferred to the first decode write for a fully shared
+prompt, elided for a sole survivor), forced preemption with swap-in AND
+re-prefill resume, a swap-IO degrade, and a live pool shrink must all be
+token-for-token what ``generate_cached`` produces for each prompt alone.
+On top sit the accounting gates: refcounts/orphans/reservations drain to
+zero through every path, and the prefix-aware resume actually skips the
+re-prefill tokens it claims to.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+pytestmark = [pytest.mark.serving, pytest.mark.paged, pytest.mark.prefix,
+              pytest.mark.cow]
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(
+        jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)}
+    )
+    return cfg, bundle, params
+
+
+def _solo(params, cfg, prompt, n, **kw):
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+
+    return np.asarray(generate_cached(params, cfg, prompt, n, **kw)
+                      )[0, prompt.size:]
+
+
+def _drained(pool):
+    return (pool.allocated_blocks == 0
+            and pool.unreserved_blocks == pool.num_blocks
+            and pool._orphans == 0)
+
+
+# -- index + pool units -------------------------------------------------------
+
+
+def test_prefix_cache_cow_unit():
+    """Partial-tail entries: one per tail length, longest content match
+    wins, total shared may equal the whole prompt, forget/trim invalidate
+    exactly what they claim, and cow=False degrades to the clamped
+    full-page walk."""
+    from gradaccum_tpu.serving import PrefixCache
+
+    pc = PrefixCache(page_size=4)
+    prompt = np.arange(11, dtype=np.int32)  # 2 full pages + 3-token tail
+    pc.insert(prompt, [7, 3])
+    pc.insert_tail(prompt, 9)
+    assert len(pc) == 2  # full chunks; sub-page entries counted apart
+    # radix-style: every sub-page prefix of every page is indexed (3 per
+    # full page) plus the final partial tail's 3 lengths
+    assert pc.tail_count == 9
+    # identical prompt: both full pages plus the whole 3-token tail
+    full, tb, tt = pc.match_cow(prompt)
+    assert (full, tb, tt) == ([7, 3], 9, 3)
+    # a prompt diverging at the last token still shares 2 tail tokens
+    other = prompt.copy()
+    other[10] = 90
+    assert pc.match_cow(other) == ([7, 3], 9, 2)
+    # a prompt diverging MID-PAGE shares the sub-page prefix of the FULL
+    # page it diverges in — the system-prompt-boundary case
+    mid = prompt.copy()
+    mid[5] = 90
+    assert pc.match_cow(mid) == ([7], 3, 1)
+    # a longer prompt with this prefix shares the full tail sub-page
+    longer = np.arange(20, dtype=np.int32)
+    assert pc.match_cow(longer) == ([7, 3], 9, 3)
+    # sub-page prompts can match a tail with ZERO full pages
+    pc2 = PrefixCache(page_size=8)
+    pc2.insert_tail(np.arange(5, dtype=np.int32), 2)
+    assert pc2.match_cow(np.arange(6, dtype=np.int32)) == ([], 2, 5)
+    # trim_tail drops only the lengths past the survivor's extent
+    pc.trim_tail(9, 2)
+    assert pc.match_cow(longer) == ([7, 3], 9, 2)
+    # forget_block kills every tail length at once
+    pc.forget_block(9)
+    assert pc.match_cow(longer) == ([7, 3], None, 0)
+    assert not pc.is_live(9)
+    # cow=False: no tail entries, match_cow == the legacy match
+    off = PrefixCache(page_size=4, cow=False)
+    off.insert(prompt, [7, 3])
+    off.insert_tail(prompt, 9)  # no-op
+    assert off.match_cow(prompt) == ([7, 3], None, 0)
+    # the strict-below clamp bites exactly at page-aligned prompts
+    aligned = np.arange(8, dtype=np.int32)
+    assert off.match_cow(aligned) == ([7], None, 0)
+    on = PrefixCache(page_size=4)
+    on.insert(prompt, [7, 3])
+    assert on.match_cow(aligned) == ([7, 3], None, 0)  # unclamped
+
+
+def test_pool_fork_cow_accounting():
+    """fork_cow swaps an adopted tail for a private block — refcounts,
+    owner, shared-count, and reservation accounting all stay truthful —
+    and ELIDES the copy when the sharer is the last reference."""
+    from gradaccum_tpu.models.gpt import GPTConfig
+    from gradaccum_tpu.serving import PagedCachePool, PoolPressure
+
+    cfg = GPTConfig.tiny_for_tests()
+    pool = PagedCachePool(cfg, num_slots=3, max_len=32, page_size=4,
+                          num_blocks=6)
+    a = pool.claim()
+    pool.reserve(a, 8)
+    pool.alloc_to(a, 7)  # 2 blocks; the 2nd holds a 3-token partial tail
+    tail = pool.blocks_of(a)[1]
+
+    b = pool.claim()
+    pool.reserve(b, 8, shared_blocks=1)  # tail fork NOT discounted
+    pool.adopt_shared(b, [pool.blocks_of(a)[0], tail])
+    assert pool.refcount(tail) == 2 and pool.shared_blocks == 2
+    old = pool.fork_cow(b, 1)
+    assert old == tail
+    new = int(pool.page_table[b, 1])
+    assert new != tail and pool.refcount(new) == 1
+    assert pool.owner_of(new) == b
+    assert pool.refcount(tail) == 1 and pool.owner_of(tail) == a
+    assert pool.shared_blocks == 1  # only the full page stays shared
+    assert pool.blocks_of(b)[1] == new
+
+    # elision: the owner releases, b re-adopts... simulate with a third
+    # slot adopting the now-orphanable tail
+    c = pool.claim()
+    pool.reserve(c, 8, shared_blocks=0)
+    pool.adopt_shared(c, [tail])
+    pool.release(a)
+    assert pool._orphans >= 1  # tail outlived its allocator
+    assert pool.fork_cow(c, 0) is None  # last ref: takes ownership
+    assert pool.owner_of(tail) == c and pool.refcount(tail) == 1
+    # the tail left the orphan ledger (now reservation-covered by c);
+    # a's OTHER block, still shared with b, remains the one orphan
+    assert pool._orphans == 1
+
+    # pressure: a fork against a dry free list under overcommit raises
+    # the structured signal, never crashes
+    pool.allow_overcommit = True
+    pool.release(b)
+    e = pool.claim()
+    pool.reserve(e, 4)
+    pool.adopt_shared(e, [tail])
+    pool.alloc_to(c, 4 * (len(pool.blocks_of(c)) + pool.free_blocks))
+    assert pool.free_blocks == 0
+    with pytest.raises(PoolPressure):
+        pool.fork_cow(e, 0)
+
+
+# -- parity gates -------------------------------------------------------------
+
+
+def _shared_trace(cfg, sys_len, n=5, seed=0):
+    """Staggered arrivals behind one SUB-PAGE-tailed system prompt."""
+    from gradaccum_tpu.serving.server import TraceItem
+
+    rng = np.random.default_rng(seed)
+    sys_p = rng.integers(0, cfg.vocab_size, sys_len).astype(np.int32)
+    items = []
+    for i in range(n):
+        tail = rng.integers(0, cfg.vocab_size,
+                            int(rng.integers(1, 5))).astype(np.int32)
+        items.append(TraceItem(
+            arrival_tick=0 if i == 0 else 1 + 2 * i,
+            prompt=np.concatenate([sys_p, tail]),
+            max_new_tokens=int(rng.integers(4, 9)),
+            eos_id=None, rng_seed=i,
+        ))
+    return items
+
+
+@pytest.mark.parametrize("sampled", [False, True])
+def test_cow_on_off_token_parity(tiny_lm, sampled):
+    """The headline gate: the same sub-page shared-prefix trace through a
+    COW engine and a cow_tails=False engine at equal pool memory emits
+    IDENTICAL per-request streams — and the COW leg really engaged
+    (adoptions, forks, strictly more prefill tokens skipped)."""
+    from gradaccum_tpu.serving import Engine, SimulationDriver
+
+    cfg, _, params = tiny_lm
+    kw = (dict(temperature=0.8, top_k=5) if sampled else {})
+    trace = _shared_trace(cfg, sys_len=9, n=5)  # 2 full pages + 1 tail tok
+
+    def run(cow):
+        engine = Engine(params, cfg, num_slots=3, max_len=32, page_size=4,
+                        prefix_cache=True, cow_tails=cow, **kw)
+        records = SimulationDriver(engine, seed=0).run(trace)
+        assert _drained(engine.pool)
+        assert engine.decode_compile_count() == 1
+        return [rec["tokens"] for rec in records], engine
+
+    off, eng_off = run(False)
+    on, eng_on = run(True)
+    assert on == off
+    m_on, m_off = eng_on.metrics, eng_off.metrics
+    assert m_on.cow_adoptions > 0
+    assert m_on.cow_forks > 0
+    assert m_on.prefill_tokens_skipped > m_off.prefill_tokens_skipped
+    assert len(eng_on.prefix_cache) == 0  # tail entries die with the pool
+    # solo ground truth (covers the sampled leg's rng discipline too)
+    for item, toks in zip(trace, on):
+        gen_kw = ({} if not sampled else
+                  dict(temperature=0.8, top_k=5,
+                       rng=jax.random.PRNGKey(item.rng_seed)))
+        np.testing.assert_array_equal(
+            np.asarray(toks),
+            _solo(params, cfg, item.prompt, item.max_new_tokens, **gen_kw))
+
+
+def test_fully_shared_prompt_defers_fork_and_drops_write(tiny_lm):
+    """An identical prompt shares its ENTIRE content: admission recomputes
+    exactly one token (the last, for logits) with its redundant write
+    dropped, allocates nothing, and the fork lands at the first decode
+    write instead — with exact greedy output."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, cfg.vocab_size, 9).astype(np.int32)
+    eng = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                 prefix_cache=True)
+    r1 = eng.submit(prompt, 6)
+    eng.step()
+    computed_before = eng.metrics.prefill_tokens_computed
+    blocks_before = eng.pool.allocated_blocks
+    r2 = eng.submit(prompt.copy(), 6)
+    # admission alone (the match seeded exactly as the step's fits gate
+    # would): adoption, one recomputed token, zero new blocks, no fork
+    # yet — the next tick then forks before r2's first decode write
+    reqs = eng.scheduler.admit(eng.pool.free_count, eng.tick_count)
+    eng._pending_match[r2] = eng.prefix_cache.match_cow(prompt)
+    eng._admit(reqs, [], [], [])
+    assert eng.metrics.prefill_tokens_computed == computed_before + 1
+    assert eng.metrics.prefill_tokens_skipped >= 8  # 9-token prompt, 1 run
+    assert eng.pool.allocated_blocks == blocks_before
+    assert eng.metrics.cow_adoptions == 1
+    assert eng.metrics.cow_forks == 0
+    assert int(eng._slot_cow[1]) == 9  # armed, unforked
+    eng._active[1] = True
+    eng.status[r2] = "running"
+    eng.run_until_idle()
+    assert eng.metrics.cow_forks == 1  # deferred to the first decode write
+    for rid in (r1, r2):
+        np.testing.assert_array_equal(np.asarray(eng.results[rid]),
+                                      _solo(params, cfg, prompt, 6))
+    assert _drained(eng.pool)
+
+
+def test_aligned_identical_prompt_shares_every_page(tiny_lm):
+    """A page-aligned identical prompt shares ALL its pages under COW —
+    the old clamp held back the final full page; now only decode pages
+    are private, and the saving is a whole block per follower."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(0, cfg.vocab_size, 8).astype(np.int32)  # 2 pages
+
+    def follower_blocks(cow):
+        eng = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                     prefix_cache=True, cow_tails=cow)
+        r1 = eng.submit(prompt, 4)
+        eng.step()
+        before = eng.pool.allocated_blocks
+        r2 = eng.submit(prompt.copy(), 4)
+        eng.step()
+        grew = eng.pool.allocated_blocks - before
+        eng.run_until_idle()
+        for rid in (r1, r2):
+            np.testing.assert_array_equal(np.asarray(eng.results[rid]),
+                                          _solo(params, cfg, prompt, 4))
+        return grew
+
+    # non-COW follower recomputes+stores the clamped last page privately;
+    # COW adopts it and only allocates the decode page
+    assert follower_blocks(True) < follower_blocks(False)
+
+
+def test_cow_spec_parity(tiny_lm):
+    """Speculative decoding over COW-shared tails: the draft prefills the
+    full prompt, the target adopts sub-page, greedy stays solo-exact."""
+    from gradaccum_tpu.models.gpt_decode import truncate_draft_params
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    dparams, dcfg = truncate_draft_params(params, cfg, 1)
+    rng = np.random.default_rng(9)
+    sys_p = rng.integers(0, cfg.vocab_size, 9).astype(np.int32)
+    prompts = [np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, k)
+                               .astype(np.int32)]) for k in (2, 3)]
+    eng = Engine(params, cfg, num_slots=3, max_len=32, page_size=4,
+                 prefix_cache=True, speculate_k=3,
+                 draft_params=dparams, draft_cfg=dcfg)
+    rids = []
+    for p in prompts:
+        rids.append(eng.submit(p, 8))
+        eng.step()
+    eng.run_until_idle()
+    assert eng.metrics.cow_adoptions >= 1
+    for p, r in zip(prompts, rids):
+        np.testing.assert_array_equal(np.asarray(eng.results[r]),
+                                      _solo(params, cfg, p, 8))
+    assert _drained(eng.pool)
+
+
+# -- preemption / resume / degrade -------------------------------------------
+
+
+@pytest.mark.parametrize("swap", ["host", "recompute"])
+def test_cow_fork_under_forced_preemption_parity(tiny_lm, swap):
+    """A COW sharer preempted mid-stream (post-fork private tail staged
+    or dropped) resumes token-for-token on both swap legs; the surviving
+    sharer is untouched; the pool drains to zero."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(10)
+    sys_p = rng.integers(0, cfg.vocab_size, 9).astype(np.int32)
+    p1 = np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, 2)
+                         .astype(np.int32)])
+    p2 = np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, 3)
+                         .astype(np.int32)])
+    eng = Engine(params, cfg, num_slots=3, max_len=32, page_size=4,
+                 prefix_cache=True, admission="quantile", swap=swap)
+    r1 = eng.submit(p1, 10)
+    eng.step()
+    r2 = eng.submit(p2, 10)
+    eng.step()
+    assert eng.metrics.cow_adoptions >= 1
+    assert eng.preempt(r2)
+    assert eng.status[r1] == "running"
+    eng.run_until_idle()
+    np.testing.assert_array_equal(np.asarray(eng.results[r1]),
+                                  _solo(params, cfg, p1, 10))
+    np.testing.assert_array_equal(np.asarray(eng.results[r2]),
+                                  _solo(params, cfg, p2, 10))
+    m = eng.metrics
+    if swap == "host":
+        assert m.swap_ins == 1
+    else:
+        assert m.reprefills == 1
+        # prefix-aware resume: the shared head was re-adopted, not
+        # recomputed
+        assert m.resume_prefill_tokens_saved >= 8
+    assert _drained(eng.pool)
+
+
+def test_unforked_cow_preemption_resumes_clean(tiny_lm):
+    """Preempting a fully shared stream BEFORE its first decode write
+    (nothing private to swap) parks an empty footprint and resumes by a
+    1-token re-prefill that re-adopts everything — exact output."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, cfg.vocab_size, 9).astype(np.int32)
+    eng = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                 prefix_cache=True, admission="quantile", swap="host")
+    r1 = eng.submit(prompt, 8)
+    eng.step()
+    r2 = eng.submit(prompt.copy(), 8)
+    # admit without ticking so r2 is still unforked, then preempt it
+    reqs = eng.scheduler.admit(eng.pool.free_count, eng.tick_count)
+    eng._pending_match[r2] = eng.prefix_cache.match_cow(prompt)
+    eng._admit(reqs, [], [], [])
+    eng._active[1] = True
+    eng.status[r2] = "running"
+    assert int(eng._slot_cow[1]) == 9
+    assert eng.preempt(r2)
+    assert eng.metrics.swap_outs == 0  # nothing private existed to stage
+    eng.run_until_idle()
+    for rid in (r1, r2):
+        np.testing.assert_array_equal(np.asarray(eng.results[rid]),
+                                      _solo(params, cfg, prompt, 8))
+    assert eng.metrics.reprefills == 1
+    assert _drained(eng.pool)
+
+
+@pytest.mark.faults
+def test_swap_degrade_releases_cow_refs_and_readopts(tiny_lm):
+    """The satellite bugfix gate: a swap-IO/sha failure at resume time
+    degrades to re-prefill WITHOUT leaking any shared/COW refcount taken
+    for the abandoned swap plan — the degraded resume re-adopts through
+    the prefix-aware path and the pool still drains to zero."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(12)
+    sys_p = rng.integers(0, cfg.vocab_size, 9).astype(np.int32)
+    p1 = np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, 2)
+                         .astype(np.int32)])
+    p2 = np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, 3)
+                         .astype(np.int32)])
+    eng = Engine(params, cfg, num_slots=3, max_len=32, page_size=4,
+                 prefix_cache=True, admission="quantile", swap="host")
+    r1 = eng.submit(p1, 10)
+    eng.step()
+    r2 = eng.submit(p2, 10)
+    eng.step()
+    assert eng.preempt(r2)
+    rec = eng._swap_store._recs[r2]
+    rec.arrays["k"].flat[0] += 1.0  # rot: the sha check must refuse it
+    eng.run_until_idle()
+    m = eng.metrics
+    assert m.swap_fallbacks == 1
+    assert m.swap_ins == 0
+    assert m.reprefills == 1
+    assert m.resume_prefill_tokens_saved >= 8  # degrade still re-adopts
+    np.testing.assert_array_equal(np.asarray(eng.results[r1]),
+                                  _solo(params, cfg, p1, 10))
+    np.testing.assert_array_equal(np.asarray(eng.results[r2]),
+                                  _solo(params, cfg, p2, 10))
+    assert _drained(eng.pool)  # no leaked refcount anywhere
+    assert len(eng._swap_store) == 0
+
+
+def test_cow_reconfig_pool_shrink_parity(tiny_lm):
+    """Live pool shrink over COW-sharing streams: every slot parks
+    through the preempt path (COW refs dropped with the slot), the
+    rebuilt pool starts with an empty index, and the resumed streams are
+    token-for-token exact."""
+    from gradaccum_tpu.serving import Engine
+    from gradaccum_tpu.serving.reconfig import pool_resize
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(13)
+    sys_p = rng.integers(0, cfg.vocab_size, 9).astype(np.int32)
+    p1 = np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, 2)
+                         .astype(np.int32)])
+    p2 = np.concatenate([sys_p, rng.integers(0, cfg.vocab_size, 3)
+                         .astype(np.int32)])
+    eng = Engine(params, cfg, num_slots=3, max_len=32, page_size=4,
+                 num_blocks=16, prefix_cache=True, admission="quantile",
+                 swap="recompute")
+    r1 = eng.submit(p1, 8)
+    eng.step()
+    r2 = eng.submit(p2, 8)
+    eng.step()
+    assert eng.metrics.cow_adoptions >= 1
+    result = eng.reconfigure(pool_resize(8))
+    assert result.ok and result.preempted == 2
+    assert len(eng.prefix_cache) == 0
+    assert not eng._slot_cow.any()
+    eng.run_until_idle()
+    np.testing.assert_array_equal(np.asarray(eng.results[r1]),
+                                  _solo(params, cfg, p1, 8))
+    np.testing.assert_array_equal(np.asarray(eng.results[r2]),
+                                  _solo(params, cfg, p2, 8))
+    assert _drained(eng.pool)
+
+
+# -- deadline-aware victim scoring -------------------------------------------
+
+
+def test_deadline_victim_cost_orders_by_progress_and_wait():
+    """The opt-in scorer keeps the stock primary term and breaks ties on
+    progress + queue wait: the near-finished (or long-waiting) request is
+    the pricier victim."""
+    from gradaccum_tpu.models.gpt import GPTConfig
+    from gradaccum_tpu.serving import PagedCachePool
+    from gradaccum_tpu.serving.admission import (
+        deadline_victim_cost,
+        victim_cost,
+    )
+
+    cfg = GPTConfig.tiny_for_tests()
+    pool = PagedCachePool(cfg, num_slots=2, max_len=16, page_size=4,
+                          num_blocks=8)
+    for s in pool.claim(), pool.claim():
+        pool.reserve(s, 8)
+        pool.alloc_to(s, 8)
+    base0 = victim_cost(pool, 0, None)
+    c_near_done = deadline_victim_cost(pool, 0, None, progress=0.9, waited=0)
+    c_fresh = deadline_victim_cost(pool, 1, None, progress=0.1, waited=0)
+    assert c_fresh < c_near_done
+    assert c_near_done[0] == base0[0]  # primary term untouched
+    c_waited = deadline_victim_cost(pool, 1, None, progress=0.1, waited=100)
+    assert c_fresh < c_waited  # long-suffering requests cost more to evict
+
+
+def test_engine_deadline_victim_score_picks_least_progress(tiny_lm):
+    """Engine(victim_score="deadline"): under pressure the engine evicts
+    the stream with the least completed work (stock scoring would pick
+    the most-freeable victim) — with parity for everyone."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    prompts = [np.arange(1, 6, dtype=np.int32),
+               np.arange(2, 7, dtype=np.int32),
+               np.arange(3, 8, dtype=np.int32)]
+
+    def run(victim_score):
+        eng = Engine(params, cfg, num_slots=4, max_len=32, page_size=4,
+                     num_blocks=9, admission="optimistic",
+                     victim_score=victim_score)
+        rids = []
+        for p in prompts:
+            rids.append(eng.submit(p, 14))
+            eng.step()
+        eng.run_until_idle()
+        assert eng.metrics.preemptions >= 1
+        for p, r in zip(prompts, rids):
+            np.testing.assert_array_equal(np.asarray(eng.results[r]),
+                                          _solo(params, cfg, p, 14))
+        return eng
+
+    eng = run("deadline")
+    assert eng.manifest()["victim_score"] == "deadline"
+    eng2 = run(None)
+    assert eng2.manifest()["victim_score"] is None
+
+    # custom callables plug straight in
+    calls = []
+
+    def my_score(engine, slot):
+        calls.append(slot)
+        return (0, slot)
+
+    eng3 = run(my_score)
+    assert calls and eng3.manifest()["victim_score"] == "custom"
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="victim_score"):
+        Engine(params, cfg, num_slots=2, max_len=16, victim_score="slo")
+
+
+# -- surfaces -----------------------------------------------------------------
+
+
+def test_cow_metrics_and_stats_surfaces(tiny_lm):
+    """Operator surfaces: manifest records cow_tails, stats()["prefix"]
+    grows a cow block, the registry exports the cow counters, and the
+    per-tick sub-page gauge samples while a tail is adopted unforked."""
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(14)
+    prompt = rng.integers(0, cfg.vocab_size, 9).astype(np.int32)
+    eng = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                 prefix_cache=True)
+    assert eng.manifest()["cow_tails"] is True
+    eng.submit(prompt, 6)
+    eng.step()
+    eng.submit(prompt.copy(), 6)
+    eng.step()
+    eng.run_until_idle()
+    m = eng.metrics.summary()
+    assert m["cow_adoptions"] == 1
+    assert m["cow_forks"] == 1
+    assert m["cow_tokens_shared"] >= 1
+    stats = ServingServer(eng).stats()
+    cow = stats["prefix"]["cow"]
+    assert cow["adoptions"] == 1 and cow["forks"] == 1
+    prom = eng.metrics.to_prometheus()
+    assert "serving_cow_adoptions_total" in prom
+    assert "serving_cow_forks_total" in prom
+    assert "serving_resume_prefill_tokens_saved_total" in prom
+
+    # cow off: knob recorded, no cow stats block
+    eng_off = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                     prefix_cache=True, cow_tails=False)
+    assert eng_off.manifest()["cow_tails"] is False
+    assert "cow" not in ServingServer(eng_off).stats()["prefix"]
+
+
+def test_elided_fork_drops_full_chunk_entry(tiny_lm):
+    """Review regression: B adopts A's final block as a fully shared COW
+    tail, A cancels, B's fork ELIDES (takes ownership) and decodes into
+    the block — the block's FULL-CHUNK index entry must die with the
+    takeover, or a later request with A's exact prompt would adopt B's
+    decode writes as prompt K/V and emit a diverged stream."""
+    from gradaccum_tpu.serving import Engine
+
+    cfg, _, params = tiny_lm
+    rng = np.random.default_rng(21)
+    pA = rng.integers(0, cfg.vocab_size, 8).astype(np.int32)  # 2 pages
+    pB = pA[:6].copy()  # 1 full page + a 2-token COW tail of A's block 1
+    eng = Engine(params, cfg, num_slots=3, max_len=32, page_size=4,
+                 prefix_cache=True)
+    rA = eng.submit(pA, 8)
+    eng.step()
+    rB = eng.submit(pB, 8)
+    # admit B WITHOUT a decode tick (the match seeded as the fits gate
+    # would): its fork stays deferred while the block is still shared
+    reqs = eng.scheduler.admit(eng.pool.free_count, eng.tick_count)
+    eng._pending_match[rB] = eng.prefix_cache.match_cow(pB)
+    eng._admit(reqs, [], [], [])
+    eng._active[1] = True
+    eng.status[rB] = "running"
+    assert eng.metrics.cow_adoptions == 1
+    assert eng.cancel(rA)  # B becomes the tail block's sole reference
+    eng.step()             # B's deferred fork elides; B decodes into it
+    assert eng.metrics.cow_forks_elided == 1
+    rC = eng.submit(pA.copy(), 8)  # A's exact prompt, B still running
+    eng.run_until_idle()
+    np.testing.assert_array_equal(np.asarray(eng.results[rB]),
+                                  _solo(params, cfg, pB, 8))
+    np.testing.assert_array_equal(np.asarray(eng.results[rC]),
+                                  _solo(params, cfg, pA, 8))
+    assert _drained(eng.pool)
+
+
+def test_is_live_ignores_subpage_entries():
+    """Review regression: with COW on, every prompt page carries sub-page
+    tail entries — the victim policy's hot term must keep reading only
+    FULL-chunk canonical blocks, or it inflates uniformly and a private
+    slot outranks the holder of a genuinely hot shared prefix."""
+    from gradaccum_tpu.serving import PrefixCache
+
+    pc = PrefixCache(page_size=4)
+    pc.insert(np.arange(8, dtype=np.int32), [5, 6])
+    assert pc.is_live(5) and pc.is_live(6)
+    pc.insert_tail(np.arange(11, dtype=np.int32), 7)  # tail-only block
+    assert pc.tail_count > 0
+    assert not pc.is_live(7)
+
+
+@pytest.mark.slow
+def test_bench_cow_fast(tmp_path):
+    """The COW bench end-to-end at --fast shapes: all three capacity legs
+    plus both resume legs present, parity everywhere, the sharing ladder
+    visible, and the acceptance passing even tiny."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from tools.bench_cow import main as bench_main
+
+    out = tmp_path / "BENCH_cow.json"
+    result = bench_main(["--fast", "--out", str(out)])
+    assert out.exists()
+    legs = {leg["leg"]: leg for leg in result["cow_legs"]}
+    assert set(legs) == {"paged", "prefix", "cow"}
+    for leg in legs.values():
+        assert leg["parity_ok"]
+        assert leg["decode_programs"] == 1
+    assert legs["cow"]["prefill_tokens_skipped"] > \
+        legs["prefix"]["prefill_tokens_skipped"]
+    assert legs["cow"]["cow_forks"] >= 1
+    assert legs["paged"]["prefill_tokens_skipped"] == 0
+    assert result["resume_tokens_x"] >= 2.0
+    assert result["fixed_parity_ok"]
+    assert result["acceptance"]["passed"]
+
+
+def test_cow_requires_prefix_mode(tiny_lm):
+    """cow_tails is a prefix-cache refinement: without the cache (or with
+    an injected cow=False index) the engine runs with COW off and says
+    so."""
+    from gradaccum_tpu.serving import Engine, PrefixCache
+
+    cfg, _, params = tiny_lm
+    eng = Engine(params, cfg, num_slots=2, max_len=16)  # fixed pool
+    assert eng.cow_tails is False
+    eng2 = Engine(params, cfg, num_slots=2, max_len=16, page_size=4)
+    assert eng2.cow_tails is False
+    pc = PrefixCache(4, cow=False)
+    eng3 = Engine(params, cfg, num_slots=2, max_len=16, page_size=4,
+                  prefix_cache=pc)
+    assert eng3.cow_tails is False  # the injected index's refusal wins

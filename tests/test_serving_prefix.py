@@ -111,8 +111,9 @@ def test_prefix_on_off_token_parity(tiny_lm, sampled):
 
 def test_prefix_hit_skips_prefill_and_shares_blocks(tiny_lm):
     """A follower with the leader's system prompt adopts the leader's
-    full-page prefix blocks (no new memory for them) and prefills only its
-    tail — the admission bill says so."""
+    full-page prefix blocks (no new memory for them) AND the leader's
+    partial tail chunk copy-on-write — prefilling only its own tokens.
+    The admission bill says so."""
     from gradaccum_tpu.serving import Engine
 
     cfg, _, params = tiny_lm
@@ -122,19 +123,23 @@ def test_prefix_hit_skips_prefill_and_shares_blocks(tiny_lm):
     engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
                     prefix_cache=True)
     engine.submit(sys_p, 8)
-    engine.step()  # leader admitted, 2 full pages indexed
+    engine.step()  # leader admitted: 2 full pages + a 1-token tail indexed
     before = engine.pool.allocated_blocks
     engine.submit(np.concatenate([sys_p, tail]), 8)
     engine.step()
     m = engine.metrics.summary()
     assert engine.metrics.prefix_hits == 1
-    assert m["prefill_tokens_skipped"] == 8       # 2 pages x 4 tokens
-    assert m["blocks_saved"] == 2
+    # 2 full pages x 4 tokens + the leader's 1-token COW tail
+    assert m["prefill_tokens_skipped"] == 9
+    assert m["blocks_saved"] == 3
+    assert m["cow_adoptions"] == 1
+    assert m["cow_forks"] == 1  # the follower's suffix write forked it
+    # post-fork, only the 2 full pages remain multiply-mapped
     assert engine.pool.shared_blocks == 2
     # the follower allocated only its unshared pages: 12-token prompt = 3
-    # pages, 2 of them shared -> 1 new prompt page, plus 1 decode page as
-    # this step's tick crossed the page boundary (an unshared admission
-    # would have added 4)
+    # pages, 2 of them shared, the tail page a COW fork -> 1 new block,
+    # plus 1 decode page as this step's tick crossed the page boundary
+    # (an unshared admission would have added 4)
     assert engine.pool.allocated_blocks == before + 2
 
 

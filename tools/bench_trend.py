@@ -93,6 +93,28 @@ def _admission_column(data) -> str:
     return "admission " + " → ".join(parts)
 
 
+def _cow_column(data) -> str:
+    """Render a ``cow_legs`` ladder (BENCH_cow.json) as the paged →
+    prefix → cow peak-concurrency/prefill progression plus the
+    prefix-aware-resume token cut."""
+    legs = data.get("cow_legs")
+    if not isinstance(legs, list) or not legs:
+        return ""
+    try:
+        parts = [
+            f"{leg['leg']} peak {int(leg['peak_concurrency'])} "
+            f"({int(leg['prefill_tokens_computed'])} prefill tok)"
+            for leg in legs
+        ]
+    except (KeyError, TypeError, ValueError):
+        return ""
+    out = "cow " + " → ".join(parts)
+    rx = data.get("resume_tokens_x")
+    if isinstance(rx, (int, float)):
+        out += f", resume tokens {rx:.1f}x fewer"
+    return out
+
+
 def _reconfig_column(data) -> str:
     """Render a ``transition`` dict (BENCH_reconfig.json) as the
     live-vs-stop-the-world availability ratios with recovery times."""
@@ -159,6 +181,7 @@ def collect(bench_dir: str):
             "memory": _memory_column(data) or None,
             "spec": _spec_column(data) or None,
             "admission": _admission_column(data) or None,
+            "cow": _cow_column(data) or None,
             "reconfig": _reconfig_column(data) or None,
             "acceptance": acceptance,
             "passed": None if acceptance is None
@@ -230,6 +253,8 @@ def main(argv=None) -> int:
                 detail += f" — {r['spec']}"
             if r.get("admission"):
                 detail += f" — {r['admission']}"
+            if r.get("cow"):
+                detail += f" — {r['cow']}"
             if r.get("reconfig"):
                 detail += f" — {r['reconfig']}"
             if required != "":
