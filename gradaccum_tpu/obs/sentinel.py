@@ -28,6 +28,11 @@ a tick-heartbeat lease per replica, and detects four anomaly classes:
   anomaly is the fleet-visible escalation, and its stock remediation
   routes the replica through recover + bounded requeue.
 
+- ``healer_frozen`` — terminal, raised BY the self-healing escalation
+  ladder (``resilience/healer.py``) when it froze itself (flap or rung
+  exhaustion): severity "page", no automatic remediation — a human
+  resets the ladder.
+
 Every NEW anomaly lands as a ``sentinel/anomaly`` span event, a flight
 recorder dump (``sentinel-<kind>``), and a registry counter bump, then
 runs the remediation callbacks registered for its kind — which are bound
@@ -35,7 +40,12 @@ to the EXISTING recovery contract (``ServingServer.request_recover`` →
 recover + bounded requeue, ``DrainConsensus.request`` → agreed drain; see
 ``resilience/remediation.py``). Anomalies are level-held: a kind/replica
 pair fires once and must resolve (heartbeat resumes, latency returns to
-baseline) before it can fire again.
+baseline) before it can fire again. The lifecycle is observable at both
+edges: :meth:`Sentinel.on` hooks the fire, :meth:`Sentinel.on_resolve`
+the resolve (what the healer's verification windows consume), every
+record carries a ``severity`` (per-kind defaults in :data:`SEVERITY`,
+overridable), and :meth:`Sentinel.ack` lets an operator acknowledge a
+firing anomaly without resolving it.
 
 Determinism: like the tracer and the SLO evaluator, the clock is
 injectable and anomaly records carry only sample-derived fields, so a
@@ -61,9 +71,26 @@ SCALE_STORM = "scale_storm"
 ENGINE_FAULT = "engine_fault"
 DEGENERATE_DRAFT = "degenerate_draft"
 PREEMPTION_STORM = "preemption_storm"
+# terminal: the self-healing ladder (resilience/healer.py) froze itself
+# (flap or rung exhaustion) and is waiting for an operator — automation
+# must never thrash, so this kind has NO automatic remediation
+HEALER_FROZEN = "healer_frozen"
 
 KINDS = (STALL, DEAD_REPLICA, LATENCY_CLIFF, SCALE_STORM, ENGINE_FAULT,
-         DEGENERATE_DRAFT, PREEMPTION_STORM)
+         DEGENERATE_DRAFT, PREEMPTION_STORM, HEALER_FROZEN)
+
+# default severity per kind: "warning" degrades service, "critical"
+# threatens it, "page" demands a human NOW (the ladder already gave up)
+SEVERITY = {
+    STALL: "critical",
+    DEAD_REPLICA: "critical",
+    LATENCY_CLIFF: "warning",
+    SCALE_STORM: "critical",
+    ENGINE_FAULT: "warning",
+    DEGENERATE_DRAFT: "warning",
+    PREEMPTION_STORM: "warning",
+    HEALER_FROZEN: "page",
+}
 
 
 class RollingBaseline:
@@ -104,17 +131,20 @@ class RollingBaseline:
 
 @dataclasses.dataclass
 class Anomaly:
-    """One anomaly-log record (fire or resolve transition)."""
+    """One anomaly-log record (fire / ack / resolve transition)."""
 
     kind: str
-    state: str  # "fire" | "resolve"
+    state: str  # "fire" | "ack" | "resolve"
     at: float
     replica: Optional[int] = None
     detail: Dict[str, object] = dataclasses.field(default_factory=dict)
+    severity: str = "warning"
+    acked: bool = False  # set on the FIRING record when an operator acks
 
     def to_dict(self) -> dict:
         return {"kind": self.kind, "state": self.state, "at": self.at,
-                "replica": self.replica, "detail": dict(self.detail)}
+                "replica": self.replica, "severity": self.severity,
+                "acked": self.acked, "detail": dict(self.detail)}
 
 
 class Sentinel:
@@ -157,6 +187,7 @@ class Sentinel:
         preempt_warmup: int = 8,
         preempt_consecutive: int = 8,
         check_interval: Optional[float] = None,
+        severity: Optional[Dict[str, str]] = None,
     ):
         if clock is None:
             t0 = time.monotonic()
@@ -188,7 +219,15 @@ class Sentinel:
         self._accept_run: Dict[Optional[int], int] = {}
         self._preempt_n: Dict[Optional[int], int] = {}
         self._preempt_run: Dict[Optional[int], int] = {}
+        self._severity = dict(SEVERITY)
+        if severity:
+            unknown = set(severity) - set(KINDS)
+            if unknown:
+                raise ValueError(f"severity overrides for unknown kinds "
+                                 f"{sorted(unknown)} (not in {KINDS})")
+            self._severity.update(severity)
         self._remedies: Dict[str, List[Callable[[Anomaly], None]]] = {}
+        self._resolve_hooks: Dict[str, List[Callable[[Anomaly], None]]] = {}
         self._firing: Dict[Tuple[str, Optional[int]], Anomaly] = {}
         self.anomalies: List[Anomaly] = []  # the log (fire + resolve)
         self._thread: Optional[threading.Thread] = None
@@ -214,6 +253,38 @@ class Sentinel:
         self._remedies.setdefault(kind, []).append(callback)
         return self
 
+    def on_resolve(self, kind: str,
+                   callback: Callable[[Anomaly], None]) -> "Sentinel":
+        """Register ``callback(resolve_record)`` for ``kind`` (or ``"*"``),
+        run when a firing anomaly of that kind RESOLVES — the other half of
+        the lifecycle :meth:`on` covers. Same contract as remediation
+        callbacks: inline on the resolving thread, exceptions recorded on
+        the tracer and swallowed (a broken hook must not block the
+        resolve). The self-healing ladder (``resilience/healer.py``) is
+        the primary consumer: a resolve inside a rung's verification
+        window is what distinguishes a healed anomaly from one that needs
+        escalation."""
+        if kind != "*" and kind not in KINDS:
+            raise ValueError(f"unknown anomaly kind {kind!r} (not in {KINDS})")
+        self._resolve_hooks.setdefault(kind, []).append(callback)
+        return self
+
+    def off(self, kind: str, callback) -> None:
+        """Remove a callback registered with :meth:`on` (a no-op when it
+        was never registered) — what lets a replaced healer detach its
+        lifecycle hooks instead of reacting as a ghost ladder."""
+        with self._lock:
+            lst = self._remedies.get(kind)
+            if lst and callback in lst:
+                lst.remove(callback)
+
+    def off_resolve(self, kind: str, callback) -> None:
+        """Remove a callback registered with :meth:`on_resolve`."""
+        with self._lock:
+            lst = self._resolve_hooks.get(kind)
+            if lst and callback in lst:
+                lst.remove(callback)
+
     # -- transitions -------------------------------------------------------
 
     def _fire(self, kind: str, replica: Optional[int], detail: dict,
@@ -222,7 +293,8 @@ class Sentinel:
         with self._lock:
             if key in self._firing:
                 return None  # level-held: already firing
-            anomaly = Anomaly(kind, "fire", float(now), replica, detail)
+            anomaly = Anomaly(kind, "fire", float(now), replica, detail,
+                              severity=self._severity.get(kind, "warning"))
             self._firing[key] = anomaly
             self.anomalies.append(anomaly)
             remedies = (self._remedies.get(kind, [])
@@ -263,14 +335,77 @@ class Sentinel:
         with self._lock:
             if key not in self._firing:
                 return
-            del self._firing[key]
-            self.anomalies.append(
-                Anomaly(kind, "resolve", float(now), replica, detail or {})
-            )
+            fired = self._firing.pop(key)
+            record = Anomaly(kind, "resolve", float(now), replica,
+                             detail or {}, severity=fired.severity)
+            self.anomalies.append(record)
+            hooks = (self._resolve_hooks.get(kind, [])
+                     + self._resolve_hooks.get("*", []))
         tr = self.tracer
         if tr.enabled:
             tr.event("sentinel/anomaly", cat="sentinel", kind=kind,
                      state="resolve", replica=replica, **(detail or {}))
+        for cb in hooks:
+            try:
+                cb(record)
+            except Exception as e:  # noqa: BLE001 — a broken hook must not block
+                if tr.enabled:
+                    tr.event("sentinel/resolve_hook", cat="sentinel",
+                             kind=kind, replica=replica,
+                             error=type(e).__name__)
+
+    # -- external detectors (the healer, operator tooling) -----------------
+
+    def fire(self, kind: str, replica: Optional[int] = None,
+             detail: Optional[dict] = None, remediate: bool = True,
+             now: Optional[float] = None) -> Optional["Anomaly"]:
+        """Raise an anomaly from OUTSIDE the sentinel's own detectors —
+        same level-held contract, span event, flight dump, counter and
+        (optionally) remediation dispatch as an internal fire. The
+        self-healing ladder uses this for its terminal ``healer_frozen``
+        signal; tests use it to drive remediation paths directly. Returns
+        the record, or None when the kind/replica pair was already
+        firing."""
+        if kind not in KINDS:
+            raise ValueError(f"unknown anomaly kind {kind!r} (not in {KINDS})")
+        t = self.clock() if now is None else float(now)
+        return self._fire(kind, replica, dict(detail or {}), t,
+                          remediate=remediate)
+
+    def resolve(self, kind: str, replica: Optional[int] = None,
+                detail: Optional[dict] = None,
+                now: Optional[float] = None) -> None:
+        """Resolve a firing anomaly from outside (the counterpart of
+        :meth:`fire`; a no-op when nothing is firing)."""
+        t = self.clock() if now is None else float(now)
+        self._resolve(kind, replica, t, detail)
+
+    def is_firing(self, kind: str, replica: Optional[int] = None) -> bool:
+        with self._lock:
+            return (kind, replica) in self._firing
+
+    def ack(self, kind: str, replica: Optional[int] = None,
+            by: str = "operator", now: Optional[float] = None) -> bool:
+        """Acknowledge a FIRING anomaly: the operator has seen it and owns
+        it. Records an ``ack`` transition in the anomaly log (and marks
+        the firing record), without resolving — the level stays held until
+        the underlying signal clears. Remediation/resolve hooks do not
+        run for acks. Returns False when nothing was firing."""
+        t = self.clock() if now is None else float(now)
+        key = (kind, replica)
+        with self._lock:
+            fired = self._firing.get(key)
+            if fired is None:
+                return False
+            fired.acked = True
+            self.anomalies.append(
+                Anomaly(kind, "ack", t, replica, {"by": by},
+                        severity=fired.severity, acked=True))
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("sentinel/anomaly", cat="sentinel", kind=kind,
+                     state="ack", replica=replica, by=by)
+        return True
 
     # -- feeders -----------------------------------------------------------
 
@@ -291,9 +426,16 @@ class Sentinel:
                      now: Optional[float] = None) -> None:
         """Feed one tick's duration into the replica's rolling baseline;
         fires ``latency_cliff`` after ``cliff_consecutive`` warmed samples
-        beyond ``cliff_score`` deviations."""
+        beyond ``cliff_score`` deviations. Samples inside a
+        :meth:`maintenance` window are DROPPED entirely: a reconfig's
+        quiesce/rebuild ticks (pool teardown, re-compile at the new
+        shape) are planned cost, and feeding them would poison the
+        baseline into masking — or worse, firing — a cliff right after
+        the pool resize."""
         t = self.clock() if now is None else float(now)
         with self._lock:
+            if self._maintenance:
+                return
             base = self._tick_base.get(replica)
             if base is None:
                 base = self._tick_base[replica] = RollingBaseline()
@@ -409,12 +551,16 @@ class Sentinel:
 
     @contextlib.contextmanager
     def maintenance(self):
-        """Pause lease-expiry checks across a PLANNED interruption (live
-        reconfiguration, checkpoint swap): every loop stops heartbeating
-        while the engine rebuilds, and that silence must not fire
-        stall/dead_replica. Reentrant. On exit, every lease restarts at
-        the current clock so the maintenance window itself never counts
-        against the next check."""
+        """Pause lease-expiry checks AND tick-baseline feeding across a
+        PLANNED interruption (live reconfiguration, checkpoint swap):
+        every loop stops heartbeating while the engine rebuilds — that
+        silence must not fire stall/dead_replica — and the rebuild's own
+        tick costs (:meth:`observe_tick` samples that straddle the
+        quiesce) must not be absorbed into the latency baselines, or the
+        first post-resize ticks read as a false ``latency_cliff``.
+        Reentrant. On exit, every lease restarts at the current clock so
+        the maintenance window itself never counts against the next
+        check."""
         with self._lock:
             self._maintenance += 1
         try:
@@ -510,7 +656,9 @@ class Sentinel:
             }
             n_anomalies = len(self.anomalies)
         return {
-            "firing": [{"kind": k, "replica": r} for k, r in self.firing()],
+            "firing": [{"kind": k, "replica": r,
+                        "severity": self._severity.get(k, "warning")}
+                       for k, r in self.firing()],
             "heartbeats": hb,
             "tick_baselines": baselines,
             "anomalies": n_anomalies,

@@ -17,7 +17,14 @@ supplies both halves of that proof:
   async checkpoint writer and lands one final checkpoint.
 - :mod:`remediation` — the obs sentinel's anomaly kinds bound to THIS
   package's recovery contract (server recover + requeue, drain
-  consensus), so detection closes the loop through proven machinery.
+  consensus), so detection closes the loop through proven machinery;
+  first-class :class:`~gradaccum_tpu.resilience.remediation.Remediation`
+  rungs package each action with applicability and verify predicates.
+- :mod:`healer` — the autonomous escalation ladder over those rungs:
+  per-anomaly-class remediation chains with verification windows,
+  cooldown + flap freeze (terminal ``healer_frozen``), and bounded
+  remediation budgets — the self-healing control plane a ServingServer
+  polls next to its watchdog.
 
 The consumers live in :mod:`gradaccum_tpu.estimator` (non-finite-gradient
 skip, checkpoint integrity, graceful shutdown) and
@@ -29,11 +36,14 @@ loss/param trajectory is bitwise identical to the uninterrupted run.
 
 from gradaccum_tpu.resilience import (
     faults,
+    healer,
     manifest,
     preemption,
     remediation,
     retry,
 )
+from gradaccum_tpu.resilience.healer import Healer, default_ladders
+from gradaccum_tpu.resilience.remediation import Remediation
 from gradaccum_tpu.resilience.faults import (
     FaultInjector,
     FaultSchedule,
@@ -51,10 +61,14 @@ from gradaccum_tpu.resilience.watchdog import Watchdog
 
 __all__ = [
     "faults",
+    "healer",
     "manifest",
     "preemption",
     "remediation",
     "retry",
+    "Healer",
+    "Remediation",
+    "default_ladders",
     "DrainConsensus",
     "FaultInjector",
     "FaultSchedule",

@@ -159,6 +159,16 @@ class AdmissionPolicy:
         return (self._governed_until is not None
                 and int(tick) < self._governed_until)
 
+    def pin(self, tick: int, ticks: Optional[int] = None) -> None:
+        """Arm the thrash governor DIRECTLY for ``ticks`` (default: the
+        policy's own ``cooldown``) — the self-healing ladder's cheapest
+        preemption-storm rung: stop admitting optimistically now, without
+        waiting for the storm counter to cross its threshold. Extends an
+        already-armed governor, never shortens it."""
+        until = int(tick) + int(self.cooldown if ticks is None else ticks)
+        if self._governed_until is None or until > self._governed_until:
+            self._governed_until = until
+
     # -- the budget rule ---------------------------------------------------
 
     def budget_tokens(self, prompt_len: int, max_new_tokens: int,

@@ -1150,6 +1150,10 @@ class Engine:
                             else None),
             "swap": self.swap_mode,
             "swap_max_bytes": self.swap_max_bytes,
+            # the self-healing ladder policy this engine serves under
+            # (set by ServingServer when a resilience/healer.py Healer is
+            # attached); None = operator-driven remediation only
+            "healer": getattr(self, "healer_knobs", None),
         }
 
     # -- request intake ---------------------------------------------------

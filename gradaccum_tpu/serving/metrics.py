@@ -160,6 +160,7 @@ class ServingMetrics:
         # swap store's live footprint (the gauge a preemption storm's
         # host-memory bill shows up on)
         self.reconfigs: Dict[str, int] = {}  # kind -> count
+        self.reconfigs_by_initiator: Dict[str, int] = {}  # operator|healer
         self.reconfig_failures = 0           # degraded (ok=False) applies
         self.reconfig_preempted = 0          # slots parked by reconfigs
         self.swap_store_bytes = 0            # last sampled held_bytes
@@ -289,15 +290,21 @@ class ServingMetrics:
         self.swap_fallbacks += 1
 
     def record_reconfig(self, kind: str, ok: bool = True,
-                        preempted: int = 0) -> None:
+                        preempted: int = 0,
+                        initiator: str = "operator") -> None:
         """One live reconfiguration applied (or, ``ok=False``, degraded —
         a rejected checkpoint kept the old state serving). Counted per
-        kind so /metrics shows resizes next to checkpoint swaps."""
+        kind so /metrics shows resizes next to checkpoint swaps, and per
+        ``initiator`` ("operator" vs "healer") so autonomous actions are
+        distinguishable from human ones on every dashboard."""
         self.reconfigs[kind] = self.reconfigs.get(kind, 0) + 1
+        self.reconfigs_by_initiator[initiator] = \
+            self.reconfigs_by_initiator.get(initiator, 0) + 1
         self.reconfig_preempted += int(preempted)
         if not ok:
             self.reconfig_failures += 1
-        labels = {"kind": kind, **(self._labels or {})}
+        labels = {"kind": kind, "initiator": initiator,
+                  **(self._labels or {})}
         self.registry.counter("serving/reconfigs_total", labels=labels,
                               help="live reconfigurations applied").inc()
 
@@ -476,6 +483,7 @@ class ServingMetrics:
             "swap_store_bytes": self.swap_store_bytes,
             "parked_peak": self.parked_peak,
             "reconfigs": dict(self.reconfigs),
+            "reconfigs_by_initiator": dict(self.reconfigs_by_initiator),
             "reconfig_failures": self.reconfig_failures,
             "reconfig_preempted": self.reconfig_preempted,
             "tokens_emitted": self.tokens_emitted,

@@ -106,6 +106,11 @@ class ReconfigSpec:
     draft_params: Any = None             # checkpoint_swap: optional new draft
     replica: Optional[int] = None        # replica_scale target
     action: Optional[str] = None         # replica_scale: "drain"|"activate"
+    # who ordered this: "operator" (a human / external tooling) or
+    # "healer" (the autonomous escalation ladder) — carried into the
+    # result, the reconfig span event, and the /metrics counter labels
+    # so a postmortem can tell automation's actions from a human's
+    initiator: str = "operator"
     # internal: a fleet fan-out computes the weights-unchanged verdict
     # ONCE and passes it down, so N replicas don't re-hash the same
     # params 2N times under their engine locks
@@ -119,19 +124,22 @@ class ReconfigSpec:
     def to_dict(self) -> dict:
         return {"kind": self.kind, "num_blocks": self.num_blocks,
                 "checkpoint": self.checkpoint, "replica": self.replica,
-                "action": self.action,
+                "action": self.action, "initiator": self.initiator,
                 "inline_params": self.params is not None}
 
 
-def pool_resize(num_blocks: int) -> ReconfigSpec:
+def pool_resize(num_blocks: int,
+                initiator: str = "operator") -> ReconfigSpec:
     """Grow/shrink a paged engine's block pool to ``num_blocks``."""
     if int(num_blocks) < 1:
         raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
-    return ReconfigSpec(POOL_RESIZE, num_blocks=int(num_blocks))
+    return ReconfigSpec(POOL_RESIZE, num_blocks=int(num_blocks),
+                        initiator=initiator)
 
 
 def checkpoint_swap(checkpoint: Optional[str] = None, params: Any = None,
-                    draft_params: Any = None) -> ReconfigSpec:
+                    draft_params: Any = None,
+                    initiator: str = "operator") -> ReconfigSpec:
     """Swap serving weights: from a sha256-manifested checkpoint path
     (file or directory — directory restore quarantines corrupt candidates
     and falls back, exactly like training resume) or an in-memory pytree.
@@ -143,21 +151,24 @@ def checkpoint_swap(checkpoint: Optional[str] = None, params: Any = None,
         raise ValueError("checkpoint_swap needs exactly one of "
                          "checkpoint= (a path) or params= (a pytree)")
     return ReconfigSpec(CHECKPOINT_SWAP, checkpoint=checkpoint,
-                        params=params, draft_params=draft_params)
+                        params=params, draft_params=draft_params,
+                        initiator=initiator)
 
 
-def replica_drain(replica: int) -> ReconfigSpec:
+def replica_drain(replica: int, initiator: str = "operator") -> ReconfigSpec:
     """Take one replica out of service: its running work is preempted
     through the park path, its queued+parked requests are re-dispatched
     across the siblings, and dispatch stops routing to it."""
-    return ReconfigSpec(REPLICA_SCALE, replica=int(replica), action="drain")
+    return ReconfigSpec(REPLICA_SCALE, replica=int(replica), action="drain",
+                        initiator=initiator)
 
 
-def replica_activate(replica: int) -> ReconfigSpec:
+def replica_activate(replica: int,
+                     initiator: str = "operator") -> ReconfigSpec:
     """Bring a drained replica back into the dispatch candidate order
     (its pool is empty — it rejoins cold, exactly like a fresh engine)."""
     return ReconfigSpec(REPLICA_SCALE, replica=int(replica),
-                        action="activate")
+                        action="activate", initiator=initiator)
 
 
 @dataclasses.dataclass
@@ -172,12 +183,13 @@ class ReconfigResult:
     reason: Optional[str] = None
     preempted: int = 0
     tick: int = 0
+    initiator: str = "operator"
     detail: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {"kind": self.kind, "ok": self.ok, "reason": self.reason,
                 "preempted": self.preempted, "tick": self.tick,
-                "detail": dict(self.detail)}
+                "initiator": self.initiator, "detail": dict(self.detail)}
 
 
 def params_digest(params) -> str:
@@ -419,11 +431,13 @@ def apply(engine, spec: ReconfigSpec) -> ReconfigResult:
         # reconfiguration fires fresh fault indices instead of replaying
         # the consumed ones
         engine._reconfig_count += 1
+    result.initiator = spec.initiator
     engine.last_reconfig = result
     engine.metrics.record_reconfig(result.kind, ok=result.ok,
-                                   preempted=result.preempted)
+                                   preempted=result.preempted,
+                                   initiator=spec.initiator)
     if tr.enabled:
         tr.event("serve/reconfig", cat="serving", kind=spec.kind,
                  ok=result.ok, preempted=result.preempted, tick=tick0,
-                 **engine._obs_args)
+                 initiator=spec.initiator, **engine._obs_args)
     return result

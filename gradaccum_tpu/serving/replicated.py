@@ -151,6 +151,8 @@ class _FleetMetrics:
             "swap_bytes_in": sum(p["swap_bytes_in"] for p in per),
             "swap_store_bytes": sum(p["swap_store_bytes"] for p in per),
             "reconfigs": _sum_dicts(p["reconfigs"] for p in per),
+            "reconfigs_by_initiator": _sum_dicts(
+                p["reconfigs_by_initiator"] for p in per),
             "per_replica": per,
         }
 
@@ -328,6 +330,9 @@ class ReplicatedEngine:
             "tp": self.tp,
             "mesh": (None if mesh is None
                      else {n: int(mesh.shape[n]) for n in mesh.axis_names}),
+            # fleet-level healer policy (ServingServer sets it when a
+            # Healer is attached) — one ladder governs every replica
+            "healer": getattr(self, "healer_knobs", None),
             "engines": [e.manifest() for e in self.replicas],
         }
 
@@ -574,6 +579,7 @@ class ReplicatedEngine:
                 self.activate_replica(replica)
                 result = reconfig_lib.ReconfigResult(
                     spec.kind, ok=True, tick=self._tick,
+                    initiator=spec.initiator,
                     detail={"replica": replica, "action": "activate",
                             "active_replicas": self.active_replicas},
                 )
@@ -601,6 +607,7 @@ class ReplicatedEngine:
                             else f"{len(failed)} displaced request(s) "
                                  "found no sibling capacity"),
                     preempted=len(displaced), tick=self._tick,
+                    initiator=spec.initiator,
                     detail={"replica": replica, "action": "drain",
                             "active_replicas": self.active_replicas,
                             "resubmitted": moved, "failed": failed,
@@ -608,11 +615,13 @@ class ReplicatedEngine:
                                else {"displaced": displaced})},
                 )
             e.metrics.record_reconfig(spec.kind, ok=result.ok,
-                                      preempted=result.preempted)
+                                      preempted=result.preempted,
+                                      initiator=spec.initiator)
             if tr.enabled:
                 tr.event("serve/reconfig", cat="serving", kind=spec.kind,
                          ok=result.ok, replica=replica,
-                         action=spec.action, **self.obs_tags())
+                         action=spec.action, initiator=spec.initiator,
+                         **self.obs_tags())
             return result
         if (spec.kind == reconfig_lib.CHECKPOINT_SWAP
                 and spec.checkpoint is not None):
@@ -628,11 +637,13 @@ class ReplicatedEngine:
                 return reconfig_lib.ReconfigResult(
                     spec.kind, ok=False,
                     reason=f"checkpoint rejected: {exc}", tick=self._tick,
+                    initiator=spec.initiator,
                     detail={"checkpoint": spec.checkpoint,
                             "quarantined": True},
                 )
             spec = reconfig_lib.checkpoint_swap(
-                params=new_params, draft_params=spec.draft_params)
+                params=new_params, draft_params=spec.draft_params,
+                initiator=spec.initiator)
         if spec.kind == reconfig_lib.POOL_RESIZE:
             # refuse BEFORE any replica mutates: a mid-loop refusal
             # (one replica's demand above the new size) must never tear
@@ -654,6 +665,7 @@ class ReplicatedEngine:
             spec.kind, ok=ok,
             reason=None if ok else next(r.reason for r in per if not r.ok),
             preempted=sum(r.preempted for r in per), tick=self._tick,
+            initiator=spec.initiator,
             detail={"per_replica": [r.to_dict() for r in per]},
         )
 

@@ -176,6 +176,16 @@ class ServingServer:
     bound to the engine's registry and ticked once per clean engine tick
     (set the evaluator's ``interval`` to throttle percentile pulls to a
     scrape-like cadence).
+    ``healer``: an optional :class:`~gradaccum_tpu.resilience.healer.
+    Healer` (built over the same ``sentinel``) — the autonomous
+    escalation ladder. The loop thread(s) poll it right next to the
+    watchdog every iteration (idle ones included, so verification
+    windows keep expiring), its actions route through the thread-safe
+    :meth:`request_recover` / :meth:`request_reconfig` entry points
+    (free-running fleets execute them under the owning replica's lock,
+    exactly like an operator's), its ladder policy lands in the
+    engine/fleet export manifest, and its live state snapshots into
+    ``stats()["healer"]``.
     ``telemetry_port``: when set (0 = ephemeral), :meth:`start` brings up
     the embedded ops endpoints (:class:`~gradaccum_tpu.obs.telemetry.
     TelemetryServer`): ``/metrics`` scrapes the engine registry,
@@ -216,6 +226,7 @@ class ServingServer:
         flight=None,
         sentinel=None,
         slo=None,
+        healer=None,
         telemetry_port: Optional[int] = None,
         telemetry_host: str = "127.0.0.1",
         free_running: bool = False,
@@ -224,6 +235,9 @@ class ServingServer:
         self._flight = flight
         self._sentinel = sentinel
         self._slo = slo
+        self._healer = None
+        if healer is not None:
+            self._attach_healer_checked(healer, engine, sentinel)
         # the engine's metrics registry: a ReplicatedEngine owns ONE shared
         # fleet registry directly (its .metrics facade has none); a single
         # Engine reaches it through ServingMetrics
@@ -294,6 +308,33 @@ class ServingServer:
                 else Watchdog(watchdog_timeout, self._on_stall,
                               tracer=engine._tracer)
             )
+
+    def _attach_healer_checked(self, healer, engine, sentinel) -> None:
+        if sentinel is None:
+            raise ValueError("a healer needs the sentinel it was built "
+                             "over passed as sentinel=")
+        if healer.sentinel is not sentinel:
+            raise ValueError("healer was built over a different sentinel "
+                             "than this server's")
+        if self._healer is not None and self._healer is not healer:
+            # the replaced ladder must stop reacting: its hooks stay
+            # subscribed on the shared sentinel otherwise, and a ghost
+            # ladder's flap detector can fire a false page
+            self._healer.detach()
+        self._healer = healer
+        # the ladder policy is part of the serving shape: record it in
+        # the engine/fleet export manifest like every other knob
+        engine.healer_knobs = healer.manifest()
+
+    def attach_healer(self, healer) -> "ServingServer":
+        """Attach (or replace) the self-healing escalation ladder. Rung
+        factories (``resilience/remediation.py``) close over the server,
+        so the natural order is: build the sentinel → build the server
+        around it → build the :class:`~gradaccum_tpu.resilience.healer.
+        Healer` over server-bound rungs → attach. Equivalent to the
+        ``healer=`` constructor knob once the ladder exists up front."""
+        self._attach_healer_checked(healer, self._engine, self._sentinel)
+        return self
 
     def start(self) -> "ServingServer":
         if self._thread is not None or self._threads:
@@ -470,65 +511,93 @@ class ServingServer:
                 stack.enter_context(self._lock)
             yield
 
+    @staticmethod
+    def _settle(fut: "Future", result=None,
+                exc: Optional[BaseException] = None) -> None:
+        """Resolve a reconfig future, tolerating one a caller already
+        cancelled/settled — an InvalidStateError out of set_result /
+        set_exception on the loop thread would otherwise turn a handled
+        refusal into a dead serving loop."""
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+        except Exception:  # noqa: BLE001 — cancelled by the caller: their loss
+            pass
+
     def _execute_reconfig(self, spec, fut: "Future") -> None:
         """Run one queued reconfiguration on a loop thread. A crash-point
         kill routes through the PROVEN fault contract (recover → flight
         dump) and then fails the future; the engine is left in a clean
-        old-or-new configuration with the displaced work parked."""
+        old-or-new configuration with the displaced work parked. The
+        future ALWAYS settles before this returns — a refusal raised
+        under the engine lock (ReconfigError, a bad spec's ValueError,
+        anything the rebuild throws) reaches the caller as that
+        exception, never as a silently pending future."""
         from gradaccum_tpu.serving import reconfig as reconfig_lib
 
         eng = self._engine
         fleet = hasattr(eng, "replicas")
         try:
-            with self._maintenance():
-                if (fleet and spec.kind == reconfig_lib.REPLICA_SCALE
-                        and spec.action == "drain"):
-                    replica = eng._check_replica(spec.replica)
-                    with self._engine_locked():
-                        src_tick = eng.replicas[replica].tick_count
-                        result = eng.reconfigure(spec, resubmit=False)
-                    displaced = result.detail.pop("displaced", [])
-                    moved, failed = self._requeue_displaced(displaced,
-                                                           src_tick)
-                    result.detail["resubmitted"] = moved
-                    result.detail["failed"] = failed
-                    if failed:
-                        result.ok = False
-                        result.reason = (f"{len(failed)} displaced "
-                                         "request(s) found no sibling "
-                                         "capacity")
+            try:
+                with self._maintenance():
+                    if (fleet and spec.kind == reconfig_lib.REPLICA_SCALE
+                            and spec.action == "drain"):
+                        replica = eng._check_replica(spec.replica)
+                        with self._engine_locked():
+                            src_tick = eng.replicas[replica].tick_count
+                            result = eng.reconfigure(spec, resubmit=False)
+                        displaced = result.detail.pop("displaced", [])
+                        moved, failed = self._requeue_displaced(displaced,
+                                                               src_tick)
+                        result.detail["resubmitted"] = moved
+                        result.detail["failed"] = failed
+                        if failed:
+                            result.ok = False
+                            result.reason = (f"{len(failed)} displaced "
+                                             "request(s) found no sibling "
+                                             "capacity")
+                    else:
+                        with self._engine_locked():
+                            result = eng.reconfigure(spec)
+            except (reconfig_lib.ReconfigError, ValueError) as exc:
+                # a REFUSED spec changed nothing: the caller gets the
+                # structured error, the engine keeps serving, and no
+                # fault is charged
+                self._settle(fut, exc=exc)
+                return
+            except BaseException as exc:  # noqa: BLE001 — the fault contract logs it
+                if not self._free_running:
+                    self._handle_engine_fault(exc)
                 else:
-                    with self._engine_locked():
-                        result = eng.reconfigure(spec)
-        except (reconfig_lib.ReconfigError, ValueError) as exc:
-            # a REFUSED spec changed nothing: the caller gets the error,
-            # the engine keeps serving, and no fault is charged
-            fut.set_exception(exc)
-            return
-        except BaseException as exc:  # noqa: BLE001 — the fault contract logs it
-            if not self._free_running:
-                self._handle_engine_fault(exc)
-            else:
-                # the crash points guarantee a clean old-or-new config
-                # with the displaced work parked, so no recover is needed
-                # — and an unscoped fleet recover would race the other
-                # replica loops. Log it like a fault, resume serving.
-                if self._sentinel is not None:
-                    self._sentinel.note_fault(error=type(exc).__name__)
-                if self._flight is not None:
-                    try:
-                        self._flight.dump("reconfig-fault",
-                                          extra={"error": repr(exc)})
-                    except Exception:  # noqa: BLE001
-                        pass
-            fut.set_exception(exc)
-            return
-        if self._flight is not None:
-            try:  # best-effort, like every other postmortem
-                self._flight.dump("reconfig", extra=result.to_dict())
-            except Exception:  # noqa: BLE001
-                pass
-        fut.set_result(result)
+                    # the crash points guarantee a clean old-or-new config
+                    # with the displaced work parked, so no recover is
+                    # needed — and an unscoped fleet recover would race
+                    # the other replica loops. Log it like a fault,
+                    # resume serving.
+                    if self._sentinel is not None:
+                        self._sentinel.note_fault(error=type(exc).__name__)
+                    if self._flight is not None:
+                        try:
+                            self._flight.dump("reconfig-fault",
+                                              extra={"error": repr(exc)})
+                        except Exception:  # noqa: BLE001
+                            pass
+                self._settle(fut, exc=exc)
+                return
+            if self._flight is not None:
+                try:  # best-effort, like every other postmortem
+                    self._flight.dump("reconfig", extra=result.to_dict())
+                except Exception:  # noqa: BLE001
+                    pass
+            self._settle(fut, result=result)
+        finally:
+            # belt and braces: no exit path may leave the caller pending
+            if not fut.done():
+                self._settle(fut, exc=RuntimeError(
+                    "reconfiguration did not settle its future "
+                    "(loop-thread bug)"))
 
     def _requeue_displaced(self, displaced, src_tick: int):
         """Re-dispatch a drained replica's displaced requests across the
@@ -610,7 +679,7 @@ class ServingServer:
             jobs = list(self._reconfigs)
             self._reconfigs.clear()
         for _, fut in jobs:  # unapplied reconfigs must not hang waiters
-            fut.set_exception(RuntimeError(
+            self._settle(fut, exc=RuntimeError(
                 "server stopped before the reconfiguration ran"))
         if wedged:
             # daemon thread stuck in a dispatch holding _lock: it dies with
@@ -753,12 +822,17 @@ class ServingServer:
             if self._engine.paged:
                 out["free_kv_blocks"] = sum(p["free_kv_blocks"] for p in per)
                 out["num_kv_blocks"] = sum(p["num_kv_blocks"] for p in per)
+            if self._healer is not None:
+                out["healer"] = self._healer.status()
             return out
         with self._lock:
             engine = self._engine
             replicas = getattr(engine, "replicas", None)
             if replicas is None:
-                return self._engine_stats(engine)
+                out = self._engine_stats(engine)
+                if self._healer is not None:
+                    out["healer"] = self._healer.status()
+                return out
             per = [self._engine_stats(e) for e in replicas]
             out = {
                 "replicas": len(replicas),
@@ -771,6 +845,8 @@ class ServingServer:
             if engine.paged:
                 out["free_kv_blocks"] = sum(p["free_kv_blocks"] for p in per)
                 out["num_kv_blocks"] = sum(p["num_kv_blocks"] for p in per)
+            if self._healer is not None:
+                out["healer"] = self._healer.status()
         return out
 
     def cancel(self, request_id: int) -> bool:
@@ -883,7 +959,7 @@ class ServingServer:
         for handle in handles:
             handle._fail(error)
         for _, fut in jobs:  # a dead loop can never apply them
-            fut.set_exception(error)
+            self._settle(fut, exc=error)
 
     def _on_stall(self, elapsed: float) -> None:
         # runs on the watchdog thread; must not touch self._lock (the
@@ -968,6 +1044,7 @@ class ServingServer:
                 handle._finish(status)
         dead: List[StreamHandle] = []
         plans = []
+        dead_jobs = []
         with self._hlock:
             for req in failed:
                 n = self._requeues.pop(req.request_id, 0)
@@ -985,6 +1062,13 @@ class ServingServer:
                 self._handles.clear()
                 self._requeues.clear()
                 plans = []
+                # queued reconfigurations can never run now (the loops
+                # exit on _error): fail their futures instead of leaving
+                # callers pending until stop()
+                dead_jobs = list(self._reconfigs)
+                self._reconfigs.clear()
+        for _, fut in dead_jobs:
+            self._settle(fut, exc=exc)
         for req, n, handle in plans:
             handle._restart()  # the generation re-runs from scratch
             remaining = (None if req.deadline_tick is None
@@ -1091,6 +1175,10 @@ class ServingServer:
                         snt.heartbeat(tick=self._engine.tick_count,
                                       busy=False)
                         snt.check()
+                    if self._healer is not None:
+                        # verification windows / cooldowns keep expiring
+                        # while the engine has nothing to decode
+                        self._healer.poll()
                     self._stop.wait(self._idle_sleep)
                     continue
                 self._faults = 0  # a clean tick resets the consecutive budget
@@ -1127,6 +1215,13 @@ class ServingServer:
                                     replica=e.replica_id)
                     snt.observe_tick(time.monotonic() - t0)
                     snt.check()
+                if self._healer is not None:
+                    # the escalation ladder runs on the loop thread, next
+                    # to the watchdog: apply/escalate/freeze decisions
+                    # happen here, actions route through request_recover /
+                    # request_reconfig and are claimed at the top of the
+                    # next iteration
+                    self._healer.poll()
                 if self._slo is not None:
                     self._slo.tick()
                 for rid, tok in events.emitted:
@@ -1207,6 +1302,12 @@ class ServingServer:
                         snt.heartbeat(replica=i, tick=eng.tick_count,
                                       busy=False)
                         snt.check()
+                    if self._healer is not None:
+                        # every replica loop advances the ladder clock;
+                        # the healer locks internally and its actions are
+                        # per-target (a rung aimed at replica j is claimed
+                        # by j's loop)
+                        self._healer.poll()
                     if self._slo is not None and i == 0:
                         # MY replica being idle says nothing about the
                         # fleet: the evaluator pulls the SHARED registry,
@@ -1227,6 +1328,8 @@ class ServingServer:
                         snt.observe_preemptions(
                             eng.metrics.recent_preemption_rate(), replica=i)
                     snt.check()
+                if self._healer is not None:
+                    self._healer.poll()
                 if self._slo is not None and i == 0:
                     self._slo.tick()
                 for rid, tok in events.emitted:

@@ -34,13 +34,47 @@ def test_chaos_smoke_passes_and_refreshes_artifact():
     assert ops["serve"]["fault_to_alert"] == {
         "crash": "engine_fault", "slow_tick": "latency_cliff"}
     assert ops["train"]["drained_at_step"] is not None
+    heal = artifact["detail"]["healer"]
+    assert heal["healable"]["healed"] >= 1
+    assert heal["unhealable"]["frozen_reason"] == "exhausted"
+    assert heal["unhealable"]["reconfigs_by_initiator"].get("healer", 0) >= 1
 
 
-# Seeds with a KNOWN failing schedule ride here as (seed, "issue #N")
-# pairs until their fix lands — the nightly sweep's triage protocol
-# (.github/workflows/chaos-nightly.yml). Empty today: seeds 1..4 were
-# swept clean when the CI job landed.
+# Seeds with a KNOWN failing schedule ride here as
+#   seed: {"issue": "issue #N", "retest_after": "YYYY-MM-DD"}
+# entries until their fix lands — the nightly sweep's triage protocol
+# (.github/workflows/chaos-nightly.yml). Every entry EXPIRES: once
+# ``retest_after`` arrives the sweep FAILS (not xfail) until the seed is
+# either fixed or consciously re-triaged with a new date — a parked seed
+# must never rot silently. Empty today: seeds 1..4 were swept clean when
+# the CI job landed.
 XFAIL_SEEDS: dict = {}
+
+
+def stale_ledger_entries(ledger: dict, today=None) -> dict:
+    """The expiry rule for XFAIL_SEEDS: an entry is STALE — and must turn
+    the sweep red — when its ``retest_after`` date has arrived, when the
+    date is missing/invalid, or when it is a legacy bare-string entry
+    with no expiry at all. Returns ``{seed: reason}``."""
+    import datetime
+
+    today = datetime.date.today() if today is None else today
+    stale = {}
+    for seed, entry in ledger.items():
+        if not isinstance(entry, dict):
+            stale[seed] = (f"{entry}: legacy entry without retest_after "
+                           "(re-triage with an expiry date)")
+            continue
+        issue = entry.get("issue", "untracked")
+        try:
+            retest = datetime.date.fromisoformat(entry["retest_after"])
+        except (KeyError, TypeError, ValueError):
+            stale[seed] = f"{issue}: missing or invalid retest_after"
+            continue
+        if today >= retest:
+            stale[seed] = (f"{issue}: retest_after {entry['retest_after']} "
+                           "has passed — fix the seed or re-triage")
+    return stale
 
 
 def test_chaos_seed_range_sweep(tmp_path):
@@ -48,12 +82,17 @@ def test_chaos_seed_range_sweep(tmp_path):
     CONSECUTIVE seeds through the one cross-phase schedule, each
     deterministic, the artifact recording every seed it covered. A seed
     listed in XFAIL_SEEDS is expected red (tracked by issue) — any OTHER
-    failure is a real regression."""
+    failure is a real regression, and a STALE ledger entry (retest date
+    passed) is a hard failure regardless of sweep outcome."""
     sys.path.insert(0, os.path.join(_REPO, "tools"))
     import json
 
     import chaos_smoke
 
+    stale = stale_ledger_entries(XFAIL_SEEDS)
+    if stale:
+        pytest.fail("stale XFAIL_SEEDS ledger entries (triaged seeds "
+                    f"cannot rot silently): {stale}")
     out = tmp_path / "chaos_sweep.json"
     rc = chaos_smoke.main(["--seed", "1", "--seed-range", "3",
                            "--json", str(out)])
@@ -63,6 +102,7 @@ def test_chaos_seed_range_sweep(tmp_path):
     expected_red = {s for s in artifact["seeds"] if s in XFAIL_SEEDS}
     if expected_red:
         pytest.xfail(f"known-red seeds {sorted(expected_red)}: "
-                     + ", ".join(XFAIL_SEEDS[s] for s in expected_red))
+                     + ", ".join(XFAIL_SEEDS[s]["issue"]
+                                 for s in expected_red))
     assert rc == 0
     assert artifact["acceptance"]["passed"] is True

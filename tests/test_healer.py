@@ -1,0 +1,882 @@
+"""Self-healing control plane: the escalation ladder from anomaly to
+reconfiguration.
+
+The load-bearing gates: (1) ladder mechanics — a rung gets a
+verification window and the healer ESCALATES past it when the anomaly
+does not resolve, a rung whose apply raises advances instead of wedging,
+exhaustion and flap both FREEZE terminally (``healer_frozen``, operator
+reset required), cooldowns gate re-entry and the per-replica remediation
+budget holds a runaway ladder; (2) the sentinel lifecycle the healer
+rides — severity on every record, resolve hooks, operator ack, and the
+maintenance-window baseline suppression (a reconfig's rebuild ticks must
+not poison the latency baseline); (3) the closed loop end-to-end — a
+degraded engine's latency cliff healed through the real
+recover/requeue contract on a lockstep server AND a free-running fleet,
+with greedy token parity, plus healer-initiated reconfigs tagged
+``initiator="healer"`` in results and metrics; (4) the XFAIL_SEEDS
+triage-ledger expiry contract from tests/test_chaos.py.
+"""
+
+import datetime
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from gradaccum_tpu.obs import sentinel as obs_sentinel
+from gradaccum_tpu.obs.sentinel import Sentinel
+from gradaccum_tpu.resilience import remediation
+from gradaccum_tpu.resilience.healer import Healer, default_ladders
+
+pytestmark = pytest.mark.healer
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(
+        jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)}
+    )
+    return cfg, bundle, params
+
+
+def _fake_clock():
+    clk = [0.0]
+    return clk, (lambda: clk[0])
+
+
+def _rung(name, log=None, fail=False, applies=True):
+    def apply(anomaly):
+        if fail:
+            raise RuntimeError(f"{name} exploded")
+        if log is not None:
+            log.append((name, anomaly.kind, anomaly.replica))
+
+    return remediation.Remediation(
+        name, apply, applies=(lambda a: applies))
+
+
+CLIFF = obs_sentinel.LATENCY_CLIFF
+
+
+# -- ladder mechanics (fake clock, stub rungs) --------------------------------
+
+
+def test_verify_timeout_escalates_then_exhaustion_freezes():
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    log = []
+    h = Healer(snt, {CLIFF: [_rung("r0", log), _rung("r1", log)]},
+               verify_window=5.0, budget_limit=10)
+    snt.fire(CLIFF)
+    assert [a["action"] for a in h.poll()] == ["r0"]
+    assert h.poll() == []  # window still open: no double-apply
+    clk[0] = 6.0  # rung 0's window expired, anomaly still firing
+    assert [a["action"] for a in h.poll()] == ["r1"]
+    clk[0] = 12.0  # past the last rung: out of ideas -> terminal freeze
+    assert h.poll() == []
+    assert h.frozen() == [{"kind": CLIFF, "replica": None,
+                           "why": "exhausted"}]
+    assert snt.is_firing(obs_sentinel.HEALER_FROZEN)
+    # terminal means terminal: more time, more polls, zero new actions
+    before = h.actions_total
+    clk[0] = 100.0
+    assert h.poll() == [] and h.actions_total == before
+    assert log == [("r0", CLIFF, None), ("r1", CLIFF, None)]
+
+
+def test_resolve_within_window_heals_and_records_mttr():
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    h = Healer(snt, {CLIFF: [_rung("r0")]}, verify_window=8.0, cooldown=4.0)
+    snt.fire(CLIFF)
+    h.poll()
+    clk[0] = 3.0
+    snt.resolve(CLIFF)
+    assert h.healed_total == 1
+    heal = h.heal_log[-1]
+    assert heal["mttr"] == 3.0 and heal["rung"] == 0
+    assert heal["action"] == "r0"
+    # cooldown gates re-entry: a refire inside it waits, then acts
+    clk[0] = 4.0
+    snt.fire(CLIFF)
+    assert h.poll() == []
+    clk[0] = 7.5  # cooldown (resolve at 3.0 + 4.0) has passed
+    assert [a["action"] for a in h.poll()] == ["r0"]
+
+
+def test_flap_freeze_is_terminal_until_operator_reset():
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    h = Healer(snt, {CLIFF: [_rung("r0")]}, verify_window=10.0,
+               cooldown=0.5, flap_limit=2, flap_window=100.0)
+    for i in range(2):  # two apply -> heal oscillations
+        clk[0] = 10.0 * i
+        snt.fire(CLIFF)
+        h.poll()
+        clk[0] = 10.0 * i + 1.0
+        snt.resolve(CLIFF)
+    assert h.healed_total == 2 and not h.frozen()
+    clk[0] = 25.0  # the third fire inside the flap window: freeze, no action
+    snt.fire(CLIFF)
+    before = h.actions_total
+    assert h.poll() == []
+    assert h.frozen() == [{"kind": CLIFF, "replica": None, "why": "flap"}]
+    assert snt.is_firing(obs_sentinel.HEALER_FROZEN)
+    frozen_fire = [a for a in snt.anomalies
+                   if a.kind == obs_sentinel.HEALER_FROZEN
+                   and a.state == "fire"]
+    assert len(frozen_fire) == 1
+    assert frozen_fire[0].severity == "page"
+    assert frozen_fire[0].detail["why"] == "flap"
+    # the freeze dump carries the ladder snapshot for the postmortem
+    assert "ladders" in frozen_fire[0].detail["healer"]
+    # no oscillation ever again without a human
+    for t in (40.0, 60.0, 80.0):
+        clk[0] = t
+        snt.resolve(CLIFF)
+        snt.fire(CLIFF)
+        assert h.poll() == []
+    assert h.actions_total == before
+    # operator reset: healer_frozen resolves, the ladder may act again
+    assert h.reset(CLIFF) == 1
+    assert not snt.is_firing(obs_sentinel.HEALER_FROZEN)
+    clk[0] = 90.0
+    assert [a["action"] for a in h.poll()] == ["r0"]
+
+
+def test_raising_rung_advances_instead_of_wedging():
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    log = []
+    h = Healer(snt, {CLIFF: [_rung("boom", fail=True), _rung("r1", log)]},
+               verify_window=50.0)
+    snt.fire(CLIFF)
+    taken = h.poll()
+    assert taken[0]["action"] == "boom" and taken[0]["error"] == "RuntimeError"
+    # NO verify-window wait after an apply error: the next poll escalates
+    assert [a["action"] for a in h.poll()] == ["r1"]
+    assert log == [("r1", CLIFF, None)]
+
+
+def test_refused_reconfig_mid_escalation_advances(tiny_lm):
+    """The satellite case verbatim: a rung whose request_reconfig is
+    REFUSED (shrink-demand check) raises on apply — the ladder must move
+    to the next rung, not wedge."""
+    from gradaccum_tpu.serving import reconfig as reconfig_lib
+
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    log = []
+
+    def refused(anomaly):
+        raise reconfig_lib.ReconfigError("cannot shrink to 1 blocks",
+                                         demand=9, supply=1)
+
+    h = Healer(snt, {CLIFF: [remediation.Remediation("shrink", refused),
+                             _rung("fallback", log)]},
+               verify_window=50.0)
+    snt.fire(CLIFF)
+    assert h.poll()[0]["error"] == "ReconfigError"
+    assert [a["action"] for a in h.poll()] == ["fallback"]
+    assert log and not h.frozen()
+
+
+def test_inapplicable_rungs_are_skipped_without_budget_charge():
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    log = []
+    h = Healer(snt, {CLIFF: [_rung("nofleet", applies=False),
+                             _rung("r1", log)]},
+               verify_window=5.0, budget_limit=10)
+    snt.fire(CLIFF)
+    assert [a["action"] for a in h.poll()] == ["r1"]
+    assert log == [("r1", CLIFF, None)]
+    assert h.actions_total == 1  # the skip was free
+
+
+def test_budget_exhaustion_holds_ladder_until_window_slides():
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    h = Healer(snt, {CLIFF: [_rung("r0"), _rung("r1"), _rung("r2"),
+                             _rung("r3")]},
+               verify_window=2.0, budget_limit=2, budget_window=50.0)
+    snt.fire(CLIFF)
+    h.poll()                   # r0 (action 1)
+    clk[0] = 3.0
+    h.poll()                   # r1 (action 2: budget now exhausted)
+    clk[0] = 6.0
+    assert h.poll() == []      # r2 HELD, not applied, not skipped
+    assert h.actions_total == 2
+    st = h.status()["ladders"][CLIFF]
+    assert st["rung"] == 1 and not st["frozen"]
+    clk[0] = 52.0              # budget window slid: the ladder resumes
+    assert [a["action"] for a in h.poll()] == ["r2"]
+    assert h.actions_total == 3
+
+
+def test_budget_is_per_replica():
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    kinds = {CLIFF: [_rung("r0")], obs_sentinel.DEAD_REPLICA: [_rung("d0")]}
+    h = Healer(snt, kinds, budget_limit=1, budget_window=50.0)
+    snt.fire(CLIFF, replica=0)
+    snt.fire(obs_sentinel.DEAD_REPLICA, replica=1)
+    taken = {a["replica"]: a["action"] for a in h.poll()}
+    # one action each: replica 0's spent budget does not starve replica 1
+    assert taken == {0: "r0", 1: "d0"}
+
+
+def test_healer_rejects_bad_ladder_policies():
+    snt = Sentinel()
+    with pytest.raises(ValueError, match="healer_frozen"):
+        Healer(snt, {obs_sentinel.HEALER_FROZEN: [_rung("r")]})
+    with pytest.raises(ValueError, match="unknown"):
+        Healer(snt, {"sharks": [_rung("r")]})
+    with pytest.raises(ValueError, match="empty"):
+        Healer(snt, {CLIFF: []})
+
+
+def test_custom_verify_predicate_rejects_coincidental_resolve():
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    verdicts = [False, True]
+    rung = remediation.Remediation(
+        "picky", lambda a: None, verify=lambda a: verdicts.pop(0))
+    h = Healer(snt, {CLIFF: [rung]}, verify_window=10.0, cooldown=0.0)
+    snt.fire(CLIFF)
+    h.poll()
+    clk[0] = 2.0
+    snt.resolve(CLIFF)       # verify says no: not credited as a heal
+    assert h.healed_total == 0
+    snt.fire(CLIFF)          # refires; rung still active, window running
+    clk[0] = 4.0
+    snt.resolve(CLIFF)       # verify says yes this time
+    assert h.healed_total == 1
+
+
+# -- sentinel lifecycle -------------------------------------------------------
+
+
+def test_anomaly_severity_defaults_and_overrides():
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    snt.fire(CLIFF)
+    assert snt.anomalies[-1].severity == "warning"
+    assert snt.anomalies[-1].to_dict()["severity"] == "warning"
+    snt.fire(obs_sentinel.DEAD_REPLICA, replica=1)
+    assert snt.anomalies[-1].severity == "critical"
+    snt.resolve(CLIFF)
+    assert snt.anomalies[-1].state == "resolve"
+    assert snt.anomalies[-1].severity == "warning"  # carried to the resolve
+    snt2 = Sentinel(severity={CLIFF: "critical"})
+    snt2.fire(CLIFF)
+    assert snt2.anomalies[-1].severity == "critical"
+    assert snt2.status()["firing"][0]["severity"] == "critical"
+    with pytest.raises(ValueError, match="unknown kinds"):
+        Sentinel(severity={"sharks": "page"})
+
+
+def test_ack_records_transition_without_resolving():
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    assert snt.ack(CLIFF) is False  # nothing firing
+    snt.fire(CLIFF)
+    clk[0] = 2.0
+    assert snt.ack(CLIFF, by="oncall") is True
+    assert snt.is_firing(CLIFF)  # acked, NOT resolved
+    states = [(a.state, a.acked) for a in snt.anomalies]
+    assert states == [("fire", True), ("ack", True)]
+    assert snt.anomalies[-1].detail == {"by": "oncall"}
+
+
+def test_resolve_hooks_run_and_are_exception_contained():
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    seen = []
+
+    def broken(record):
+        raise RuntimeError("hook bug")
+
+    snt.on_resolve(CLIFF, broken)
+    snt.on_resolve("*", lambda r: seen.append((r.kind, r.state, r.at)))
+    snt.fire(CLIFF)
+    clk[0] = 5.0
+    snt.resolve(CLIFF)
+    assert seen == [(CLIFF, "resolve", 5.0)]
+    with pytest.raises(ValueError, match="unknown"):
+        snt.on_resolve("sharks", lambda r: None)
+
+
+def test_maintenance_suppresses_baseline_feeding():
+    """The satellite bugfix: samples emitted during a maintenance window
+    (reconfig quiesce/rebuild) must not feed the EWMA latency baseline —
+    and must not fire a cliff — or the first post-resize ticks read as a
+    false latency_cliff."""
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock, cliff_warmup=4, cliff_consecutive=2,
+                   cliff_score=4.0)
+    for _ in range(8):
+        snt.observe_tick(1.0)
+    base = snt._tick_base[None]
+    mean_before, n_before = base.mean, base.n
+    with snt.maintenance():
+        for _ in range(6):  # rebuild-cost ticks: huge, and planned
+            snt.observe_tick(50.0)
+    assert not snt.is_firing(CLIFF), \
+        "maintenance ticks fired a latency_cliff"
+    assert base.n == n_before and base.mean == mean_before, \
+        "maintenance ticks fed the EWMA baseline"
+    # after the window: normal ticks are still normal (no false cliff
+    # from a dragged-up baseline, no masked detector)
+    snt.observe_tick(1.0)
+    assert not snt.is_firing(CLIFF)
+    snt.observe_tick(30.0)
+    snt.observe_tick(30.0)  # a REAL post-maintenance cliff still fires
+    assert snt.is_firing(CLIFF)
+
+
+# -- rung factories over real engines -----------------------------------------
+
+
+def test_governor_pin_rung_arms_the_thrash_governor(tiny_lm):
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                    num_blocks=16, admission="optimistic")
+    server = ServingServer(engine)
+    rung = remediation.governor_pin_rung(server, ticks=64)
+    anomaly = obs_sentinel.Anomaly(obs_sentinel.PREEMPTION_STORM, "fire", 0.0)
+    assert rung.applies(anomaly)
+    assert rung.apply(anomaly)
+    assert engine.admission_policy.governed(engine.tick_count)
+    assert not engine.admission_policy.governed(engine.tick_count + 65)
+    # pin never shortens an already-armed governor
+    engine.admission_policy.pin(engine.tick_count, 128)
+    engine.admission_policy.pin(engine.tick_count, 10)
+    assert engine.admission_policy.governed(engine.tick_count + 100)
+
+
+def test_pool_grow_rung_tags_reconfig_as_healer(tiny_lm):
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                    num_blocks=12)
+    rng = np.random.default_rng(3)
+    with ServingServer(engine) as server:
+        rung = remediation.pool_grow_rung(server, factor=1.5, max_blocks=64)
+        anomaly = obs_sentinel.Anomaly(CLIFF, "fire", 0.0)
+        assert rung.applies(anomaly)
+        h = server.submit(rng.integers(0, cfg.vocab_size, 5).astype(np.int32),
+                          4)
+        assert rung.apply(anomaly)
+        deadline = time.monotonic() + 30
+        while engine.num_blocks == 12 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert engine.num_blocks == 18
+        h.result(timeout=60)
+        assert engine.last_reconfig.initiator == "healer"
+        assert engine.metrics.reconfigs_by_initiator == {"healer": 1}
+        # growth cap: at/above max_blocks the rung reports inapplicable
+        capped = remediation.pool_grow_rung(server, factor=2.0, max_blocks=18)
+        assert capped.apply(anomaly) is False
+
+
+def test_operator_reconfig_keeps_operator_initiator(tiny_lm):
+    from gradaccum_tpu.serving import Engine, pool_resize
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                    num_blocks=12)
+    result = engine.reconfigure(pool_resize(16))
+    assert result.initiator == "operator"
+    assert result.to_dict()["initiator"] == "operator"
+    assert engine.metrics.reconfigs_by_initiator == {"operator": 1}
+    assert engine.metrics.summary()["reconfigs_by_initiator"] == \
+        {"operator": 1}
+
+
+def test_drain_replica_rung_needs_fleet_and_replica(tiny_lm):
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    server = ServingServer(Engine(params, cfg, num_slots=2, max_len=32))
+    rung = remediation.drain_replica_rung(server)
+    assert not rung.applies(
+        obs_sentinel.Anomaly(obs_sentinel.DEAD_REPLICA, "fire", 0.0,
+                             replica=1))
+    assert not rung.applies(
+        obs_sentinel.Anomaly(obs_sentinel.DEAD_REPLICA, "fire", 0.0))
+
+
+def test_default_ladders_shape():
+    snt = Sentinel()
+
+    class _Srv:  # only rung factories' surface is needed to BUILD
+        _engine = None
+
+    ladders = default_ladders(server=_Srv(), checkpoint="/tmp/ck")
+    assert [r.name for r in ladders[CLIFF]] == \
+        ["recover_requeue", "replica_drain", "pool_grow"]
+    assert [r.name for r in ladders[obs_sentinel.PREEMPTION_STORM]] == \
+        ["governor_pin", "pool_grow"]
+    assert [r.name for r in ladders[obs_sentinel.DEAD_REPLICA]] == \
+        ["recover_requeue", "replica_drain"]
+    assert [r.name for r in ladders[obs_sentinel.SCALE_STORM]] == \
+        ["checkpoint_rollback"]
+    h = Healer(snt, ladders)
+    m = h.manifest()
+    assert m["ladders"][CLIFF] == ["recover_requeue", "replica_drain",
+                                   "pool_grow"]
+    assert m["flap_limit"] == 3 and m["budget_limit"] == 4
+
+
+# -- the closed loop end-to-end ----------------------------------------------
+
+
+class _Degrader:
+    """Wraps one engine's step/recover: from arm() on, every step sleeps
+    ``delay`` until recover() runs — a persistent degradation only the
+    recovery path clears (what makes MTTR depend on remediation)."""
+
+    def __init__(self, engine, delay=0.15):
+        self.active = False
+        self.delay = delay
+        self._step, self._recover = engine.step, engine.recover
+        engine.step = self.step
+        engine.recover = self.recover
+
+    def arm(self):
+        self.active = True
+
+    def step(self):
+        if self.active:
+            time.sleep(self.delay)
+        return self._step()
+
+    def recover(self):
+        self.active = False
+        return self._recover()
+
+
+def test_healer_end_to_end_latency_cliff_recover(tiny_lm):
+    """A degraded engine's latency cliff healed autonomously through the
+    REAL recover + requeue contract on the loop thread: anomaly fires,
+    rung 0 applies, the engine recovers, the cliff resolves inside the
+    verification window, and every stream keeps greedy parity."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=64)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=(int(rng.integers(2, 6)),)).astype(np.int32)
+               for _ in range(3)]
+    # warm every program OUTSIDE the watched window: a compile spike as
+    # the FIRST baseline sample would anchor the EWMA a thousand ticks
+    # high and mask the cliff (the _ops_chaos idiom)
+    for p in prompts[:2]:
+        engine.submit(p, 3)
+    engine.run_until_idle()
+    for rid in list(engine.results):
+        engine.pop_result(rid)
+    deg = _Degrader(engine)
+    snt = Sentinel(cliff_warmup=4, cliff_consecutive=2, cliff_score=5.0,
+                   lease=60.0)
+    server = ServingServer(engine, max_requeues=8, max_engine_faults=8,
+                           sentinel=snt)
+    healer = Healer(snt, {CLIFF: [remediation.recover_rung(server)]},
+                    verify_window=30.0, cooldown=0.0)
+    server.attach_healer(healer)
+    with server:
+        handles = [server.submit(p, 24) for p in prompts]
+        # let the baseline warm on healthy ticks, then degrade
+        deadline = time.monotonic() + 30
+        while engine.tick_count < 8 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        deg.arm()
+        results = [h.result(timeout=180) for h in handles]
+        stats = server.stats()
+    assert healer.healed_total >= 1, snt.status()
+    heal = healer.heal_log[0]
+    assert heal["kind"] == CLIFF and heal["action"] == "recover_requeue"
+    assert not deg.active, "the recover rung never reached the engine"
+    assert not healer.frozen()
+    assert stats["healer"]["healed_total"] >= 1
+    assert engine.manifest()["healer"]["ladders"][CLIFF] == \
+        ["recover_requeue"]
+    for prompt, (tokens, reason) in zip(prompts, results):
+        assert reason in ("eos", "length"), reason
+        want = np.asarray(generate_cached(params, cfg, prompt, 24))
+        np.testing.assert_array_equal(np.asarray(tokens),
+                                      want[0, prompt.size:])
+
+
+def test_healer_free_running_fleet_heals_one_replica(tiny_lm):
+    """The free-running leg: one replica of a fleet degrades, its
+    latency cliff fires replica-scoped, the healer's recover rung routes
+    to THAT replica's loop (under its lock), and the fleet keeps parity
+    throughout."""
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.serving import ReplicatedEngine, ServingServer
+
+    cfg, _, params = tiny_lm
+    fleet = ReplicatedEngine(params, cfg, replicas=2, tp=1,
+                             num_slots=2, max_len=64)
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=(int(rng.integers(2, 6)),)).astype(np.int32)
+               for _ in range(4)]
+    # warm both replicas' programs outside the watched window
+    for p in prompts:
+        fleet.submit(p, 3)
+    fleet.run_until_idle()
+    for rid in list(fleet.results):
+        fleet.pop_result(rid)
+    deg = _Degrader(fleet.replicas[1])
+    snt = Sentinel(cliff_warmup=4, cliff_consecutive=2, cliff_score=5.0,
+                   lease=60.0)
+    server = ServingServer(fleet, max_requeues=8, max_engine_faults=8,
+                           sentinel=snt, free_running=True)
+    healer = Healer(snt, {CLIFF: [remediation.recover_rung(server)]},
+                    verify_window=30.0, cooldown=0.0)
+    server.attach_healer(healer)
+    with server:
+        handles = [server.submit(p, 20) for p in prompts]
+        deadline = time.monotonic() + 30
+        while min(e.tick_count for e in fleet.replicas) < 8 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        deg.arm()
+        results = [h.result(timeout=180) for h in handles]
+    heals = [x for x in healer.heal_log if x["replica"] == 1]
+    assert heals, (healer.heal_log, snt.status())
+    assert not deg.active
+    # fleet manifest records the ladder policy
+    assert fleet.manifest()["healer"]["ladders"][CLIFF] == \
+        ["recover_requeue"]
+    for prompt, (tokens, reason) in zip(prompts, results):
+        assert reason in ("eos", "length"), reason
+        want = np.asarray(generate_cached(params, cfg, prompt, 20))
+        np.testing.assert_array_equal(np.asarray(tokens),
+                                      want[0, prompt.size:])
+
+
+def test_server_rejects_healer_without_its_sentinel(tiny_lm):
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    snt = Sentinel()
+    healer = Healer(snt, {CLIFF: [_rung("r")]})
+    with pytest.raises(ValueError, match="sentinel"):
+        ServingServer(engine, healer=healer)
+    with pytest.raises(ValueError, match="different sentinel"):
+        ServingServer(engine, sentinel=Sentinel(), healer=healer)
+
+
+# -- XFAIL_SEEDS ledger expiry (tests/test_chaos.py) --------------------------
+
+
+def test_xfail_ledger_staleness_contract():
+    import test_chaos
+
+    today = datetime.date(2026, 8, 4)
+    fresh = {"issue": "issue #12", "retest_after": "2026-12-01"}
+    expired = {"issue": "issue #9", "retest_after": "2026-08-01"}
+    legacy = "issue #3"
+    missing = {"issue": "issue #5"}
+    stale = test_chaos.stale_ledger_entries(
+        {1: fresh, 2: expired, 3: legacy, 4: missing}, today=today)
+    assert 1 not in stale
+    assert set(stale) == {2, 3, 4}
+    assert "issue #9" in stale[2] and "2026-08-01" in stale[2]
+    # the boundary day itself is already stale: retest means retest
+    stale = test_chaos.stale_ledger_entries(
+        {7: {"issue": "issue #7", "retest_after": "2026-08-04"}}, today=today)
+    assert 7 in stale
+    # the shipped ledger must never be stale (this IS the rot gate for
+    # entries committed to the tree)
+    assert test_chaos.stale_ledger_entries(test_chaos.XFAIL_SEEDS) == {}
+
+
+@pytest.mark.slow
+def test_bench_heal_fast_structure(tmp_path):
+    """Slow lane: the MTTR bench runs end to end (--fast) and writes a
+    well-formed artifact clearing its own acceptance bar — healer MTTR
+    at least 1.5x better than the operator stub, parity both legs, flap
+    freeze terminal."""
+    import json
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import bench_heal
+
+    out = str(tmp_path / "BENCH_heal.json")
+    rc = bench_heal.main(["--fast", "--json", out])
+    assert rc == 0
+    with open(out) as f:
+        artifact = json.load(f)
+    assert artifact["acceptance"]["passed"] is True
+    assert artifact["mttr_ratio"] >= 1.5
+    for leg in ("healer", "operator_stub"):
+        assert artifact[leg]["parity"] is True
+        assert artifact[leg]["anomaly_episodes"] >= 1
+    assert artifact["flap"]["terminal"] is True
+    assert artifact["flap"]["healer_frozen_fires"] == 1
+
+
+# -- review-hardening regressions ---------------------------------------------
+
+
+def test_reset_keeps_page_while_another_ladder_frozen():
+    """Review regression: healer_frozen is level-held PER REPLICA — a
+    partial reset must not silence the page while a second frozen ladder
+    on the same replica remains (nothing would ever re-raise it)."""
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    h = Healer(snt, {CLIFF: [_rung("r0")],
+                     obs_sentinel.STALL: [_rung("s0")]},
+               verify_window=2.0)
+    snt.fire(CLIFF)
+    snt.fire(obs_sentinel.STALL)
+    h.poll()
+    clk[0] = 3.0
+    h.poll()  # both ladders exhausted -> both frozen, one page held
+    assert len(h.frozen()) == 2
+    assert snt.is_firing(obs_sentinel.HEALER_FROZEN)
+    assert h.reset(CLIFF) == 1
+    # stall's ladder is still frozen: the page must stay out
+    assert snt.is_firing(obs_sentinel.HEALER_FROZEN)
+    assert h.reset(obs_sentinel.STALL) == 1
+    assert not snt.is_firing(obs_sentinel.HEALER_FROZEN)
+
+
+def test_budget_hold_emits_transitions_once_not_per_poll():
+    """Review regression: a budget hold with an expired verify window
+    must emit ONE verify_timeout and ONE budget_held transition, not one
+    per poll — the server polls every loop iteration."""
+    from gradaccum_tpu.obs.metrics import MetricsRegistry
+
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    reg = MetricsRegistry(subdir="healer-test")
+    h = Healer(snt, {CLIFF: [_rung("r0"), _rung("r1")]},
+               verify_window=2.0, budget_limit=1, budget_window=100.0,
+               registry=reg)
+    snt.fire(CLIFF)
+    h.poll()  # r0: budget spent
+    clk[0] = 5.0  # window expired; escalation blocked by the budget
+    for _ in range(50):
+        h.poll()
+
+    def count(reason):
+        return reg.counter("healer/transitions_total",
+                           labels={"reason": reason}).value
+
+    assert count("verify_timeout") == 1
+    assert count("budget_held") == 1
+    assert h.actions_total == 1
+    clk[0] = 150.0  # budget window slid: the held escalation lands once
+    assert [a["action"] for a in h.poll()] == ["r1"]
+
+
+def test_async_reconfig_refusal_escalates_ladder():
+    """Review regression: reconfig rungs only ENQUEUE (request_reconfig
+    returns a Future) — a refusal settled later on the loop thread must
+    still advance the ladder, via the escalate channel, instead of
+    reading as a successful apply."""
+    from concurrent.futures import Future
+
+    from gradaccum_tpu.serving.reconfig import ReconfigError
+
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    log = []
+    futs = []
+
+    def enqueue_only(anomaly, escalate=None):
+        fut = Future()
+        futs.append(fut)
+        remediation._watch_reconfig(fut, escalate)
+
+    h = Healer(snt, {CLIFF: [remediation.Remediation("grow", enqueue_only),
+                             _rung("fallback", log)]},
+               verify_window=50.0)
+    snt.fire(CLIFF)
+    assert [a["action"] for a in h.poll()] == ["grow"]
+    assert h.poll() == []  # nothing settled yet: window holds
+    futs[0].set_exception(ReconfigError("cannot shrink", demand=9, supply=1))
+    # NO verify-window wait: the async refusal escalates at the next poll
+    assert [a["action"] for a in h.poll()] == ["fallback"]
+    assert log
+    # a late/duplicate report after the ladder moved on is ignored
+    f2 = Future()
+    remediation._watch_reconfig(f2, h._escalate_cb((CLIFF, None), 0))
+    f2.set_exception(ReconfigError("stale"))
+    assert h.poll() == []  # fallback's window still open, nothing reruns
+
+
+def test_async_degraded_result_escalates_too():
+    from concurrent.futures import Future
+
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    log = []
+    futs = []
+
+    class _Degraded:
+        ok = False
+
+    def enqueue_only(anomaly, escalate=None):
+        fut = Future()
+        futs.append(fut)
+        remediation._watch_reconfig(fut, escalate)
+
+    h = Healer(snt, {CLIFF: [remediation.Remediation("roll", enqueue_only),
+                             _rung("next", log)]},
+               verify_window=50.0)
+    snt.fire(CLIFF)
+    h.poll()
+    futs[0].set_result(_Degraded())  # quarantined ckpt: ok=False
+    assert [a["action"] for a in h.poll()] == ["next"]
+
+
+def test_governor_pin_targets_only_the_anomalous_replica(tiny_lm):
+    """Review regression: a replica-scoped preemption_storm must pin
+    ONLY that replica's thrash governor — healthy neighbors keep their
+    optimistic admission."""
+    from gradaccum_tpu.serving import ReplicatedEngine, ServingServer
+
+    cfg, _, params = tiny_lm
+    fleet = ReplicatedEngine(params, cfg, replicas=2, tp=1, num_slots=2,
+                             max_len=32, page_size=4, num_blocks=16,
+                             admission="optimistic")
+    server = ServingServer(fleet)
+    rung = remediation.governor_pin_rung(server, ticks=64)
+    anomaly = obs_sentinel.Anomaly(obs_sentinel.PREEMPTION_STORM, "fire",
+                                   0.0, replica=1)
+    assert rung.apply(anomaly)
+    assert not fleet.replicas[0].admission_policy.governed(
+        fleet.replicas[0].tick_count)
+    assert fleet.replicas[1].admission_policy.governed(
+        fleet.replicas[1].tick_count)
+    # an engine-level anomaly (replica=None) still pins everywhere
+    rung.apply(obs_sentinel.Anomaly(obs_sentinel.PREEMPTION_STORM, "fire",
+                                    0.0))
+    assert fleet.replicas[0].admission_policy.governed(
+        fleet.replicas[0].tick_count)
+
+
+def test_replaced_healer_detaches_and_stops_reacting(tiny_lm):
+    """Review regression: attaching a replacement ladder must DETACH the
+    old healer's sentinel hooks — a ghost ladder's flap detector must
+    not trip (and page) on anomalies the live ladder owns."""
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg, _, params = tiny_lm
+    engine = Engine(params, cfg, num_slots=2, max_len=32)
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    server = ServingServer(engine, sentinel=snt)
+    old = Healer(snt, {CLIFF: [_rung("old")]}, cooldown=0.0, flap_limit=2,
+                 flap_window=1e9)
+    server.attach_healer(old)
+    for i in range(2):  # old healer heals twice: one more fire would flap
+        clk[0] = 10.0 * i
+        snt.fire(CLIFF)
+        old.poll()
+        snt.resolve(CLIFF)
+    new = Healer(snt, {CLIFF: [_rung("new")]})
+    server.attach_healer(new)
+    clk[0] = 50.0
+    snt.fire(CLIFF)
+    # the ghost neither froze nor paged; the live ladder owns the fire
+    assert old.poll() == [] and not old.frozen()
+    assert not snt.is_firing(obs_sentinel.HEALER_FROZEN)
+    assert [a["action"] for a in new.poll()] == ["new"]
+    assert engine.manifest()["healer"]["ladders"][CLIFF] == ["new"]
+
+
+def test_inapplicable_apply_refunds_budget():
+    """Review regression: a rung whose apply returns False at runtime
+    (e.g. pool_grow at its cap) must not consume a budget slot — skips
+    are budget-free by contract."""
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    log = []
+    capped = remediation.Remediation("capped", lambda a: False)
+    h = Healer(snt, {CLIFF: [capped, _rung("real", log)]},
+               verify_window=5.0, budget_limit=1, budget_window=100.0)
+    snt.fire(CLIFF)
+    h.poll()   # capped applies -> False -> refunded, escalate_now
+    assert h.actions_total == 0
+    assert [a["action"] for a in h.poll()] == ["real"]  # budget still free
+    assert h.actions_total == 1
+
+
+def test_budget_holds_across_kinds_in_one_poll():
+    """Review regression: two anomaly kinds on one replica planned in
+    the SAME poll must not overshoot the per-replica budget."""
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    h = Healer(snt, {CLIFF: [_rung("r0")],
+                     obs_sentinel.STALL: [_rung("s0")]},
+               verify_window=1000.0, budget_limit=1, budget_window=100.0)
+    snt.fire(CLIFF, replica=1)
+    snt.fire(obs_sentinel.STALL, replica=1)
+    taken = h.poll()
+    assert len(taken) == 1 and h.actions_total == 1
+    clk[0] = 150.0  # budget window slides: the held kind acts
+    assert len(h.poll()) == 1 and h.actions_total == 2
+
+
+def test_kwargs_only_apply_receives_escalate_by_keyword():
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    seen = {}
+
+    def kw_apply(anomaly, **kw):
+        seen.update(kw)
+
+    h = Healer(snt, {CLIFF: [remediation.Remediation("kw", kw_apply)]})
+    snt.fire(CLIFF)
+    taken = h.poll()
+    assert taken == [{"kind": CLIFF, "replica": None, "rung": 0,
+                      "action": "kw"}]  # no apply_error: the call worked
+    assert callable(seen.get("escalate"))
+    # a 1-arg callable never gets a surprise second argument
+    ok = remediation.Remediation("plain", lambda a: None)
+    assert ok.apply(obs_sentinel.Anomaly(CLIFF, "fire", 0.0),
+                    escalate=lambda r: None)
+
+
+def test_late_refire_after_verify_reject_restarts_at_rung_zero():
+    """Review regression: a rung kept alive by a verify-rejected resolve
+    must not let a much-later refire (a new incident) skip the cheap
+    rungs — an expired window at fire time restarts the ladder."""
+    clk, clock = _fake_clock()
+    snt = Sentinel(clock=clock)
+    log = []
+    r0 = remediation.Remediation("r0", lambda a: log.append("r0"),
+                                 verify=lambda a: False)
+    h = Healer(snt, {CLIFF: [r0, _rung("r1", log)]}, verify_window=10.0)
+    snt.fire(CLIFF)
+    h.poll()
+    clk[0] = 2.0
+    snt.resolve(CLIFF)      # verify rejects: rung 0 stays active
+    clk[0] = 500.0          # long quiet: the next fire is a NEW incident
+    snt.fire(CLIFF)
+    assert [a["action"] for a in h.poll()] == ["r0"]  # not r1
+    assert log == ["r0", "r0"]

@@ -672,3 +672,53 @@ def test_bench_reconfig_fast_structure(tmp_path):
         t = artifact["transition"][kind]
         assert t["availability_ratio"] > 0
         assert t["live"]["time_to_recover_ticks"] is not None
+
+
+def test_refused_spec_settles_future_with_reconfig_error(cfg, params):
+    """Regression (self-healing PR): a spec REFUSED on the loop thread —
+    shrink below live demand, raised under the engine lock — must settle
+    the caller's Future with the structured ReconfigError, never leave it
+    pending. The error keeps its demand/supply fields through the
+    Future."""
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                    num_blocks=24)
+    with ServingServer(engine) as server:
+        h = server.submit(_prompts(1, cfg, seed=5)[0], 12)
+        fut = server.request_reconfig(pool_resize(1))
+        with pytest.raises(ReconfigError) as ei:
+            fut.result(timeout=60)
+        assert ei.value.supply == 1 and ei.value.demand is not None
+        # the engine kept serving: nothing changed, no fault charged
+        assert engine.num_blocks == 24
+        h.result(timeout=60)
+    assert engine.metrics.reconfigs == {}
+
+
+def test_giveup_fails_pending_reconfig_future(cfg, params):
+    """Regression (self-healing PR): a reconfig queued while the engine
+    thread is mid-tick must FAIL (not hang) when that tick's fault blows
+    the give-up budget — the loop exits on _error and can never run the
+    queued spec, so its Future must carry the engine error."""
+    engine = Engine(params, cfg, num_slots=2, max_len=32, page_size=4,
+                    num_blocks=24)
+    in_step = threading.Event()
+    release = threading.Event()
+
+    def wedged_step():
+        in_step.set()
+        assert release.wait(timeout=60)
+        raise RuntimeError("tick died after the reconfig was queued")
+
+    engine.step = wedged_step
+    server = ServingServer(engine, max_engine_faults=0).start()
+    try:
+        server.submit(_prompts(1, cfg, seed=6)[0], 4)
+        assert in_step.wait(timeout=60)
+        fut = server.request_reconfig(pool_resize(32))
+        release.set()
+        with pytest.raises(RuntimeError, match="tick died"):
+            fut.result(timeout=60)
+    finally:
+        release.set()
+        with pytest.raises(RuntimeError):
+            server.stop()  # the give-up is loud at the lifecycle level

@@ -133,6 +133,26 @@ def _reconfig_column(data) -> str:
     return "live-vs-stw " + ", ".join(parts)
 
 
+def _mttr_column(data) -> str:
+    """Render BENCH_heal.json's MTTR comparison: autonomous-ladder vs
+    operator-stub recovery ticks, plus the flap leg's terminal verdict."""
+    healer = data.get("healer")
+    stub = data.get("operator_stub")
+    if not isinstance(healer, dict) or not isinstance(stub, dict):
+        return ""
+    try:
+        out = (f"MTTR healer {float(healer['mean_mttr_ticks']):g} vs "
+               f"operator {float(stub['mean_mttr_ticks']):g} ticks "
+               f"({float(data['mttr_ratio']):.2f}x)")
+    except (KeyError, TypeError, ValueError):
+        return ""
+    flap = data.get("flap")
+    if isinstance(flap, dict) and "terminal" in flap:
+        out += (", flap-freeze terminal"
+                if flap["terminal"] else ", flap-freeze NOT terminal")
+    return out
+
+
 def _memory_column(data) -> str:
     """Render a mixed-precision ``rows`` ladder (BENCH_mixed.json) as the
     per-replica optimizer+accumulator bytes/param progression."""
@@ -183,6 +203,7 @@ def collect(bench_dir: str):
             "admission": _admission_column(data) or None,
             "cow": _cow_column(data) or None,
             "reconfig": _reconfig_column(data) or None,
+            "mttr": _mttr_column(data) or None,
             "acceptance": acceptance,
             "passed": None if acceptance is None
             else bool(acceptance.get("passed")),
@@ -257,6 +278,8 @@ def main(argv=None) -> int:
                 detail += f" — {r['cow']}"
             if r.get("reconfig"):
                 detail += f" — {r['reconfig']}"
+            if r.get("mttr"):
+                detail += f" — {r['mttr']}"
             if required != "":
                 detail += f" [{required}]"
             if not r["passed"]:

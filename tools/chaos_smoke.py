@@ -36,6 +36,12 @@ requests with greedy parity throughout, and a 2-host lease-expiry leg
 where the survivor resolves a gone host's consensus round without
 waiting out the barrier timeout.
 
+The healer phase runs the sweep with the self-healing ladder ENABLED
+(``resilience/healer.py``): a healable persistent degradation must heal
+autonomously through recover/requeue (parity intact), and an unhealable
+one must escalate through a healer-tagged pool-grow reconfiguration and
+then freeze terminally (``healer_frozen``) instead of thrashing.
+
 Everything is deterministic under the seed (same seed, same chaos, same
 trajectory). Writes ``BENCH_chaos.json`` with an acceptance block that
 ``tools/bench_trend.py`` aggregates, and exits 0 on PASS — wired as the
@@ -75,6 +81,10 @@ def draw_plan(seed: int) -> dict:
         # seed still replays the same train/serve/paged chaos as before
         "reconfig_shrink_blocks": int(rng.integers(10, 15)),
         "reconfig_crash_index": int(rng.integers(0, 2)),
+        # healer phase (same append-only discipline): when the persistent
+        # degradation arms, and the unhealable leg's starting pool
+        "healer_degrade_tick": int(rng.integers(8, 14)),
+        "healer_pool_blocks": int(rng.integers(18, 25)),
     }
 
 
@@ -707,6 +717,172 @@ def _ops_chaos(seed: int, log):
     return detail
 
 
+def _healer_chaos(seed: int, log, plan):
+    """The self-healing phase: the escalation ladder ENABLED over the
+    seeded schedule. Two legs. (a) HEALABLE — a persistent degradation
+    (every tick slow until recover runs) arms mid-traffic; the healer's
+    latency_cliff ladder must heal it through the real recover/requeue
+    contract with greedy parity. (b) UNHEALABLE — recover does NOT clear
+    the degradation; the ladder must ESCALATE through a healer-tagged
+    pool-grow reconfiguration (initiator=\"healer\" on the result and
+    /metrics) and then freeze TERMINALLY (``healer_frozen``, severity
+    page) instead of thrashing — with every stream still parity-clean."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.obs import sentinel as obs_sentinel
+    from gradaccum_tpu.obs.sentinel import Sentinel
+    from gradaccum_tpu.resilience import remediation
+    from gradaccum_tpu.resilience.healer import Healer
+    from gradaccum_tpu.serving import Engine, ServingServer
+
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(jax.random.PRNGKey(0),
+                         {"input_ids": np.zeros((1, 8), np.int32)})
+    detail = {}
+
+    def degrade(engine, healable):
+        state = {"on": False}
+        orig_step, orig_recover = engine.step, engine.recover
+
+        def step():
+            if state["on"]:
+                _time.sleep(0.05)
+            return orig_step()
+
+        def recover():
+            if healable:
+                state["on"] = False
+            return orig_recover()
+
+        engine.step = step
+        engine.recover = recover
+        return state
+
+    def warm(engine, prompts):
+        for p in prompts[:2]:
+            engine.submit(p, 3)
+        engine.run_until_idle()
+        for rid in list(engine.results):
+            engine.pop_result(rid)
+
+    arm_tick = plan["healer_degrade_tick"]
+    rng = np.random.default_rng(seed + 11)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=(int(rng.integers(2, 7)),)).astype(np.int32)
+               for _ in range(4)]
+
+    # -- leg A: healable — the cliff heals through recover + requeue
+    engine = Engine(params, cfg, num_slots=2, max_len=64)
+    warm(engine, prompts)
+    wedge = degrade(engine, healable=True)
+    snt = Sentinel(cliff_warmup=4, cliff_consecutive=2, cliff_score=6.0,
+                   lease=60.0)
+    server = ServingServer(engine, max_requeues=6, max_engine_faults=6,
+                           sentinel=snt)
+    healer = Healer(
+        snt,
+        {obs_sentinel.LATENCY_CLIFF: [remediation.recover_rung(server)]},
+        verify_window=20.0, cooldown=0.5)
+    server.attach_healer(healer)
+    log(f"[chaos/healer] healable leg: degradation arms at tick "
+        f">= {arm_tick}")
+    with server:
+        handles = [server.submit(p, 24) for p in prompts]
+        deadline = _time.monotonic() + 60
+        while engine.tick_count < arm_tick \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.005)
+        wedge["on"] = True
+        results = [h.result(timeout=300) for h in handles]
+    assert healer.healed_total >= 1, \
+        f"the ladder never healed the cliff ({snt.status()})"
+    assert not wedge["on"], "recover never reached the degraded engine"
+    assert not healer.frozen()
+    for prompt, (tokens, reason) in zip(prompts, results):
+        assert reason in ("eos", "length"), reason
+        want = np.asarray(generate_cached(params, cfg, prompt, 24))
+        np.testing.assert_array_equal(np.asarray(tokens),
+                                      want[0, prompt.size:])
+    detail["healable"] = {
+        "healed": healer.healed_total,
+        "mttr": [round(h["mttr"], 3) for h in healer.heal_log],
+        "actions": healer.actions_total,
+    }
+    log(f"[chaos/healer] healable PASS: {healer.healed_total} heal(s) "
+        f"via recover_requeue, parity clean")
+
+    # -- leg B: unhealable — escalate to a healer-tagged reconfig, then
+    # freeze terminally
+    nb = plan["healer_pool_blocks"]
+    engine = Engine(params, cfg, num_slots=2, max_len=64, page_size=4,
+                    num_blocks=nb)
+    warm(engine, prompts)
+    wedge = degrade(engine, healable=False)
+    snt = Sentinel(cliff_warmup=4, cliff_consecutive=2, cliff_score=6.0,
+                   lease=60.0)
+    server = ServingServer(engine, max_requeues=8, max_engine_faults=8,
+                           sentinel=snt)
+    healer = Healer(
+        snt,
+        {obs_sentinel.LATENCY_CLIFF: [
+            remediation.recover_rung(server),
+            remediation.pool_grow_rung(server, factor=1.5)]},
+        verify_window=1.0, cooldown=0.5, flap_limit=32)
+    server.attach_healer(healer)
+    log(f"[chaos/healer] unhealable leg: {nb} blocks, ladder "
+        "recover -> pool_grow -> frozen")
+    with server:
+        handles = [server.submit(p, 24) for p in prompts]
+        deadline = _time.monotonic() + 60
+        while engine.tick_count < arm_tick \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.005)
+        wedge["on"] = True
+        deadline = _time.monotonic() + 120
+        while not healer.frozen() and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        actions_at_freeze = healer.actions_total
+        results = [h.result(timeout=300) for h in handles]
+        stats = server.stats()
+    frozen = healer.frozen()
+    assert frozen and frozen[0]["why"] == "exhausted", \
+        f"ladder did not freeze terminally: {frozen}"
+    assert healer.actions_total == actions_at_freeze, \
+        "the frozen ladder kept acting"
+    assert engine.num_blocks > nb, \
+        "the pool_grow rung never applied its reconfiguration"
+    by_init = engine.metrics.reconfigs_by_initiator
+    assert by_init.get("healer", 0) >= 1, by_init
+    assert engine.last_reconfig.initiator == "healer"
+    frozen_fires = [a for a in snt.anomalies
+                    if a.kind == obs_sentinel.HEALER_FROZEN
+                    and a.state == "fire"]
+    assert len(frozen_fires) == 1 and frozen_fires[0].severity == "page"
+    assert stats["healer"]["frozen_total"] == 1
+    for prompt, (tokens, reason) in zip(prompts, results):
+        assert reason in ("eos", "length"), reason
+        want = np.asarray(generate_cached(params, cfg, prompt, 24))
+        np.testing.assert_array_equal(np.asarray(tokens),
+                                      want[0, prompt.size:])
+    detail["unhealable"] = {
+        "escalations": healer.actions_total,
+        "pool_blocks": [nb, engine.num_blocks],
+        "reconfigs_by_initiator": dict(by_init),
+        "frozen_reason": frozen[0]["why"],
+        "healer_frozen_severity": frozen_fires[0].severity,
+    }
+    log(f"[chaos/healer] unhealable PASS: escalated through a "
+        f"healer-tagged pool grow ({nb}->{engine.num_blocks} blocks), "
+        "froze terminally, parity clean")
+    return detail
+
+
 def run_one(seed: int, log) -> dict:
     """Every chaos phase under ONE seeded plan; returns the detail dict
     (raises AssertionError on any gate failure)."""
@@ -729,6 +905,7 @@ def run_one(seed: int, log) -> dict:
         detail["paged"] = _paged_chaos(seed, log, plan)
         detail["reconfig"] = _reconfig_chaos(seed, log, plan)
         detail["ops"] = _ops_chaos(seed, log)
+        detail["healer"] = _healer_chaos(seed, log, plan)
     return detail
 
 
@@ -762,7 +939,13 @@ def main(argv=None) -> int:
                 "sentinel remediation fires through the "
                 "recover/requeue/drain contract with the post-remediation "
                 "stream token-parity clean, and seeded simulation alert "
-                "streams are byte-identical")
+                "streams are byte-identical; healer phase (ladder "
+                "ENABLED): a healable persistent degradation heals "
+                "autonomously through recover/requeue with parity, an "
+                "unhealable one escalates through a healer-tagged "
+                "pool-grow reconfig (initiator=healer) and freezes "
+                "TERMINALLY (healer_frozen, severity page, zero actions "
+                "after the freeze)")
     passed = True
     detail = {}
     seeds = list(range(args.seed, args.seed + max(1, args.seed_range)))
