@@ -1,0 +1,49 @@
+"""One quantized, tiered memory ladder shared by serving and training.
+
+Three pieces, each usable alone, composed by the stacks above:
+
+- :mod:`~gradaccum_tpu.memory.quant` — 8-bit quantization with
+  per-block scales. The SAME codec backs int8 KV blocks (behind the
+  serving engine's existing ``cache_dtype`` contract) and 8-bit Adam
+  moments (behind ``ops/adamw.py``'s explicit ``moment_dtype``
+  contract): one scale per contiguous value block, absmax/127, error
+  bounded by ``absmax / 254`` per element.
+- :mod:`~gradaccum_tpu.memory.tiers` — a :class:`TieredStore` ladder
+  device pool → host memory → disk with LRU aging, sha-checked
+  promotion/demotion, and structured spill/pressure events feeding
+  the sentinel/healer plane (``Engine(swap="tiered")``).
+- :mod:`~gradaccum_tpu.memory.radix` — a compressed radix tree over
+  token sequences, replacing the linear sub-page tail index in
+  ``serving/cache_pool.py``: prefix/COW lookup walks tokens in
+  O(match length) instead of hashing every sub-page prefix.
+"""
+
+from gradaccum_tpu.memory.quant import (  # noqa: F401
+    Q_MAX,
+    QuantKV,
+    QuantTensor,
+    dequantize_blockwise,
+    is_quantized_kv,
+    kv_dequantize,
+    kv_map,
+    kv_quantize,
+    quantize_blockwise,
+)
+from gradaccum_tpu.memory.radix import RadixIndex  # noqa: F401
+
+__all__ = [
+    "Q_MAX", "QuantKV", "QuantTensor", "dequantize_blockwise",
+    "is_quantized_kv", "kv_dequantize", "kv_map", "kv_quantize",
+    "quantize_blockwise", "RadixIndex", "TierEvent", "TieredStore",
+]
+
+
+def __getattr__(name):
+    # tiers builds on serving/swap.py, and serving transitively reaches
+    # back into ops/ (which imports memory/quant for q8 moments) — so the
+    # tier names resolve lazily to keep the package import acyclic
+    if name in ("TierEvent", "TieredStore"):
+        from gradaccum_tpu.memory import tiers
+
+        return getattr(tiers, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,141 @@
+"""8-bit quantization with per-block scales — one codec, two customers.
+
+The codec is symmetric absmax quantization: a block of values shares one
+f32 scale ``absmax(block) / 127``, each value stores as
+``round(x / scale)`` in int8. Dequantization is ``q * scale``; the
+round-trip error is bounded by ``scale / 2 = absmax(block) / 254`` per
+element — the bound the tier-1 round-trip tests assert.
+
+Two block shapes serve the two memory consumers:
+
+- **KV blocks** (:class:`QuantKV`): the block is one head-vector — the
+  last axis (``head_dim``) of the pool layout ``[L, blocks, H, page,
+  hd]``, so the scale array is the payload shape minus its last axis.
+  One scale per written (position, head) vector means a pool write is
+  still a pure scatter (quantize, then scatter q and scale at the SAME
+  indices, the scale one rank lower) — no read-modify-write of
+  neighboring positions' scales, which is what keeps every compiled
+  serving program a single dispatch. ``QuantKV`` is a NamedTuple and
+  therefore a JAX pytree: it flows through ``jit``, donation,
+  ``device_put`` and sharding exactly like the plain array it replaces.
+- **Optimizer moments** (:class:`QuantTensor`): the block is a flat run
+  of :data:`BLOCK` consecutive values of the flattened leaf (the
+  Adam-mini / 8-bit-optimizer blocking), so the overhead is 4 bytes of
+  scale per 256 values — ~1.016 bytes/value against fp32's 4.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Q_MAX = 127          # symmetric int8 range [-127, 127]; -128 unused
+BLOCK = 256          # flat-codec block: 4B scale per 256 values
+
+
+class QuantKV(NamedTuple):
+    """A quantized KV pool array: int8 payload + f32 per-vector scales.
+
+    ``scale.shape == q.shape[:-1]`` — one scale per last-axis vector.
+    Being a NamedTuple it is a JAX pytree, so pool plumbing (donation,
+    ``device_put`` with a sharding, ``jax.tree`` maps) treats it as the
+    two arrays it is; compiled programs branch on ``isinstance`` at
+    trace time."""
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        """The logical (payload) shape — what the fp pool would have."""
+        return self.q.shape
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.q.size) + 4 * int(self.scale.size)
+
+
+def is_quantized_kv(x) -> bool:
+    return isinstance(x, QuantKV)
+
+
+def kv_map(fn, *kvs):
+    """Apply ``fn`` leafwise across KV arrays that are either all
+    :class:`QuantKV` or all plain arrays — the one helper that lets
+    pool plumbing (slicing, padding, host transfer) stay agnostic to
+    whether the pool is quantized."""
+    if isinstance(kvs[0], QuantKV):
+        return QuantKV(fn(*[x.q for x in kvs]),
+                       fn(*[x.scale for x in kvs]))
+    return fn(*kvs)
+
+
+def kv_quantize(x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize ``x`` along its LAST axis: returns ``(q int8, scale
+    f32)`` with ``scale.shape == x.shape[:-1]``. Jit-safe; an all-zero
+    vector quantizes to zeros with scale 0 (dequantizes to exact
+    zeros)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / Q_MAX
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(xf / safe[..., None]), -Q_MAX, Q_MAX)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def kv_dequantize(q, scale, dtype):
+    """Invert :func:`kv_quantize`: ``q * scale`` upcast to ``dtype``."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantTensor:
+    """A blockwise-quantized flat tensor: int8 rows of :data:`BLOCK`
+    values, one f32 scale per row, plus the original shape (static aux
+    data, so it survives ``jit`` tracing unchanged). Used for 8-bit
+    Adam moments behind ``ops/adamw.py``'s ``moment_dtype="q8"``."""
+
+    __slots__ = ("q", "scale", "shape")
+
+    def __init__(self, q, scale, shape):
+        self.q = q            # int8 [rows, BLOCK]
+        self.scale = scale    # f32 [rows]
+        self.shape = tuple(int(s) for s in shape)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, children):
+        return cls(children[0], children[1], shape)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.q.size) + 4 * int(self.scale.size)
+
+    def __repr__(self) -> str:
+        return f"QuantTensor(shape={self.shape}, rows={self.scale.shape})"
+
+
+def quantize_blockwise(x, block: int = BLOCK) -> QuantTensor:
+    """Flatten ``x``, quantize runs of ``block`` consecutive values with
+    one shared absmax scale each (the final run zero-padded — padding
+    can only shrink nothing: zeros never raise an absmax)."""
+    shape = x.shape
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = (-flat.size) % block
+    rows = jnp.pad(flat, (0, pad)).reshape(-1, block)
+    scale = jnp.max(jnp.abs(rows), axis=1) / Q_MAX
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(rows / safe[:, None]), -Q_MAX, Q_MAX)
+    return QuantTensor(q.astype(jnp.int8), scale.astype(jnp.float32), shape)
+
+
+def dequantize_blockwise(t: QuantTensor, dtype):
+    """Invert :func:`quantize_blockwise` back to the original shape."""
+    n = 1
+    for s in t.shape:
+        n *= s
+    rows = t.q.astype(jnp.float32) * t.scale[:, None]
+    return rows.reshape(-1)[:n].reshape(t.shape).astype(dtype)

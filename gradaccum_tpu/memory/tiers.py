@@ -1,0 +1,234 @@
+"""TieredStore: the host → disk rung of the memory ladder.
+
+The device KV pool is the top rung; the serving engine already demotes
+preempted requests into a :class:`~gradaccum_tpu.serving.swap.HostSwapStore`
+(host RAM) and falls back to re-prefill when a record is gone. This
+module adds the rung below: when host memory is under pressure the
+least-recently-used records spill to disk (one ``.npz`` per record),
+and a ``get`` of a disk-resident record re-verifies its sha digest and
+promotes it back to host. Only when BOTH rungs are full does capacity
+become an error, and only disk overflow turns into a true eviction —
+which the engine already survives (missing record → re-prefill).
+
+The store is plug-compatible with ``HostSwapStore`` (same
+put/get/discard surface and counters), so ``Engine(swap="tiered")`` is
+the only opt-in. Every demotion/promotion/eviction appends a structured
+:class:`TierEvent`; the engine forwards spill pressure to the sentinel
+plane as a ``tier_thrash`` anomaly.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import zipfile
+from typing import Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+from gradaccum_tpu.serving.swap import (
+    HostSwapStore,
+    SwapCapacityError,
+    SwapError,
+    SwapRecord,
+)
+
+
+class TierEvent(NamedTuple):
+    """One ladder transition, for tests and the obs export."""
+
+    kind: str   # "demote" | "promote" | "evict" | "corrupt"
+    rid: int
+    tier: str   # tier the record LANDED in ("disk", "host", "gone")
+    nbytes: int
+
+
+class TieredStore:
+    """Host rung (LRU, capacity-managed here) over a disk rung.
+
+    The inner :class:`HostSwapStore` is deliberately uncapped — its own
+    FIFO eviction would silently DROP records, where this ladder's
+    contract is that host overflow demotes to disk and only disk
+    overflow loses data. ``held_bytes``/``max_bytes`` report the host
+    rung so the engine's existing swap gauges keep their meaning.
+    """
+
+    def __init__(self, host_max_bytes: int = 64 * 1024 * 1024,
+                 disk_max_bytes: int = 1024 * 1024 * 1024,
+                 disk_dir: Optional[str] = None):
+        self.max_bytes = int(host_max_bytes)
+        self.disk_max_bytes = int(disk_max_bytes)
+        self._dir = disk_dir or tempfile.mkdtemp(prefix="gradaccum_tier_")
+        os.makedirs(self._dir, exist_ok=True)
+        self._host = HostSwapStore(max_bytes=None)
+        self._lru: List[int] = []            # host rids, oldest first
+        self._disk: Dict[int, int] = {}      # rid -> nbytes (insertion = LRU)
+        self._disk_held = 0
+        self.events: List[TierEvent] = []
+        self.demotions = 0
+        self.promotions = 0
+        self.evictions = 0          # records lost off the disk rung
+        self.corruptions = 0        # disk records failing sha re-verify
+
+    # -- HostSwapStore-compatible surface ---------------------------------
+
+    @property
+    def held_bytes(self) -> int:
+        return self._host.held_bytes
+
+    @property
+    def bytes_out(self) -> int:
+        return self._host.bytes_out
+
+    @property
+    def bytes_in(self) -> int:
+        return self._host.bytes_in
+
+    @property
+    def disk_held_bytes(self) -> int:
+        return self._disk_held
+
+    def __len__(self) -> int:
+        return len(self._host) + len(self._disk)
+
+    def __contains__(self, rid: int) -> bool:
+        return rid in self._host or rid in self._disk
+
+    def put(self, rid: int, arrays: Dict[str, np.ndarray],
+            page_start: int, length: int) -> SwapRecord:
+        """Stage a record onto the ladder: host if it fits (demoting LRU
+        records to disk to make room), straight to disk if it is larger
+        than the whole host rung, error only if it exceeds both."""
+        nbytes = sum(int(a.nbytes) for a in arrays.values())
+        if nbytes > self.max_bytes and nbytes > self.disk_max_bytes:
+            raise SwapCapacityError(
+                f"swap record for request {rid} is {nbytes} bytes but the "
+                f"ladder holds {self.held_bytes}/{self.max_bytes} host and "
+                f"{self._disk_held}/{self.disk_max_bytes} disk bytes — "
+                f"resuming by re-prefill instead")
+        self.discard(rid)
+        rec = self._host.put(rid, arrays, page_start, length)
+        self._lru.append(rid)
+        if nbytes > self.max_bytes:
+            self._demote(rid)           # oversized for host: disk-only
+        else:
+            while self._host.held_bytes > self.max_bytes and len(self._lru) > 1:
+                self._demote(self._lru[0])
+        return rec
+
+    def get(self, rid: int) -> SwapRecord:
+        """Fetch a record, promoting it back to host if it had spilled.
+        Raises KeyError if absent (the engine re-prefills) and SwapError
+        if a disk record fails its sha re-verify (record is dropped —
+        corrupt state must not resume)."""
+        if rid in self._host:
+            rec = self._host.get(rid)
+            self._lru.remove(rid)
+            self._lru.append(rid)
+            return rec
+        if rid not in self._disk:
+            raise KeyError(f"no swap record for request {rid}")
+        rec = self._load_disk(rid)
+        nbytes = self._disk.pop(rid)
+        self._disk_held -= nbytes
+        self._unlink(rid)
+        if rec.compute_digest() != rec.digest:
+            self.corruptions += 1
+            self.events.append(TierEvent("corrupt", rid, "gone", nbytes))
+            raise SwapError(
+                f"disk tier record for request {rid} failed digest "
+                f"re-verification — dropping it")
+        self.promotions += 1
+        self.events.append(TierEvent("promote", rid, "host", nbytes))
+        if nbytes <= self.max_bytes:
+            self._host.put(rid, rec.arrays, rec.page_start, rec.length)
+            self._lru.append(rid)
+            while self._host.held_bytes > self.max_bytes and len(self._lru) > 1:
+                self._demote(self._lru[0])
+        return rec
+
+    def discard(self, rid: int) -> None:
+        if rid in self._host:
+            self._host.discard(rid)
+            self._lru.remove(rid)
+        if rid in self._disk:
+            self._disk_held -= self._disk.pop(rid)
+            self._unlink(rid)
+
+    def clear(self) -> None:
+        self._host.clear()
+        self._lru.clear()
+        for rid in list(self._disk):
+            self._unlink(rid)
+        self._disk.clear()
+        self._disk_held = 0
+
+    # -- ladder internals -------------------------------------------------
+
+    def _path(self, rid: int) -> str:
+        return os.path.join(self._dir, f"swap_{rid}.npz")
+
+    def _unlink(self, rid: int) -> None:
+        try:
+            os.unlink(self._path(rid))
+        except OSError:
+            pass
+
+    def _demote(self, rid: int) -> None:
+        """Move one host record to the disk rung, evicting disk LRU
+        records if the rung overflows (true data loss, counted)."""
+        rec = self._host.peek(rid)
+        self._host.discard(rid)
+        self._lru.remove(rid)
+        payload = dict(rec.arrays)
+        payload["__meta__"] = np.asarray(
+            [rec.page_start, rec.length], dtype=np.int64)
+        payload["__digest__"] = np.frombuffer(
+            rec.digest.encode("ascii"), dtype=np.uint8).copy()
+        np.savez(self._path(rid), **payload)
+        self._disk[rid] = rec.nbytes
+        self._disk_held += rec.nbytes
+        self.demotions += 1
+        self.events.append(TierEvent("demote", rid, "disk", rec.nbytes))
+        while self._disk_held > self.disk_max_bytes and len(self._disk) > 1:
+            old = next(iter(self._disk))
+            self._disk_held -= self._disk.pop(old)
+            self._unlink(old)
+            self.evictions += 1
+            self.events.append(TierEvent("evict", old, "gone", 0))
+
+    def _load_disk(self, rid: int) -> SwapRecord:
+        try:
+            with np.load(self._path(rid)) as z:
+                meta = z["__meta__"]
+                digest = bytes(z["__digest__"]).decode("ascii")
+                arrays = {k: z[k] for k in z.files
+                          if k not in ("__meta__", "__digest__")}
+        except (OSError, KeyError, ValueError, zipfile.BadZipFile) as e:
+            # BadZipFile is a bare Exception subclass, not an OSError: a
+            # truncated .npz container must land here, not escape
+            self.corruptions += 1
+            self._disk_held -= self._disk.pop(rid)
+            self._unlink(rid)
+            self.events.append(TierEvent("corrupt", rid, "gone", 0))
+            raise SwapError(
+                f"disk tier record for request {rid} is unreadable: {e}")
+        return SwapRecord(arrays=arrays,
+                          page_start=int(meta[0]), length=int(meta[1]),
+                          digest=digest,
+                          nbytes=sum(int(a.nbytes) for a in arrays.values()))
+
+    def stats(self) -> Dict[str, int]:
+        """The obs-export block: rung occupancy and ladder traffic."""
+        return {
+            "host_records": len(self._host),
+            "host_bytes": self._host.held_bytes,
+            "host_max_bytes": self.max_bytes,
+            "disk_records": len(self._disk),
+            "disk_bytes": self._disk_held,
+            "disk_max_bytes": self.disk_max_bytes,
+            "demotions": self.demotions,
+            "promotions": self.promotions,
+            "evictions": self.evictions,
+            "corruptions": self.corruptions,
+        }

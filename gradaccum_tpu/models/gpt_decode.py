@@ -28,7 +28,19 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from gradaccum_tpu.memory.quant import (
+    QuantKV,
+    is_quantized_kv,
+    kv_dequantize,
+    kv_map,
+    kv_quantize,
+)
 from gradaccum_tpu.models.gpt import GPTConfig
+
+
+def _is_int8(cache_dtype) -> bool:
+    return cache_dtype is not None and \
+        jnp.dtype(cache_dtype) == jnp.dtype(jnp.int8)
 
 
 class DecodeCache(NamedTuple):
@@ -143,6 +155,12 @@ def init_cache(cfg: GPTConfig, batch: int, max_len: int,
         raise ValueError(
             f"max_len {max_len} exceeds max_position_embeddings "
             f"{cfg.max_position_embeddings}"
+        )
+    if _is_int8(cache_dtype):
+        raise ValueError(
+            "cache_dtype=int8 needs per-vector quantization scales, which "
+            "only the paged pool layout carries (init_paged_pool) — the "
+            "fixed-slot cache stores raw dtypes only"
         )
     hd = cfg.hidden_size // cfg.num_heads
     shape = (cfg.num_layers, batch, cfg.num_heads, max_len, hd)
@@ -432,13 +450,49 @@ def init_paged_pool(cfg: GPTConfig, num_blocks: int, page_size: int,
         raise ValueError(f"page_size must be >= 1, got {page_size}")
     hd = cfg.hidden_size // cfg.num_heads
     shape = (cfg.num_layers, num_blocks, cfg.num_heads, page_size, hd)
+    if _is_int8(cache_dtype):
+        # int8 pool: QuantKV pytrees — int8 payload plus one f32 scale per
+        # (position, head) hd-vector (memory/quant.py). Every paged program
+        # below branches on the pool type at TRACE time, so the int8 path
+        # keeps the compile-once discipline: writes quantize then scatter
+        # q and scale at the same indices, reads gather then dequantize.
+        def zeros():
+            return QuantKV(jnp.zeros(shape, jnp.int8),
+                           jnp.zeros(shape[:-1], jnp.float32))
+
+        return zeros(), zeros()
     dtype = cfg.dtype if cache_dtype is None else cache_dtype
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
+def _pool_write(pool, idx, values):
+    """Scatter ``values`` (compute dtype, last axis hd) into the pool at
+    the index tuple ``idx``. Quantized pools land q and the per-vector
+    scales at the SAME indices (the scale array is one rank lower, so the
+    identical tuple addresses it) — still a pure scatter, one dispatch."""
+    if is_quantized_kv(pool):
+        q, s = kv_quantize(values)
+        return QuantKV(pool.q.at[idx].set(q), pool.scale.at[idx].set(s))
+    return pool.at[idx].set(values.astype(pool.dtype))
+
+
+def _virt_view(pool, i, page_table, kv_shape, dtype):
+    """Gather layer ``i``'s pages through ``page_table`` into the virtual
+    ``[B, H, max_pages * page_size, hd]`` view, upcasting (and for
+    quantized pools, dequantizing) to the compute ``dtype``."""
+    if is_quantized_kv(pool):
+        q = pool.q[i][page_table].transpose(0, 2, 1, 3, 4).reshape(kv_shape)
+        s = pool.scale[i][page_table].transpose(0, 2, 1, 3) \
+            .reshape(kv_shape[:-1])
+        return kv_dequantize(q, s, dtype)
+    return pool[i][page_table].transpose(0, 2, 1, 3, 4) \
+        .reshape(kv_shape).astype(dtype)
+
+
 @jax.jit
 def _gather_blocks(pool_k, pool_v, block_ids):
-    return pool_k[:, block_ids], pool_v[:, block_ids]
+    take = lambda a: a[:, block_ids]
+    return kv_map(take, pool_k), kv_map(take, pool_v)
 
 
 def gather_blocks(pool_k, pool_v, block_ids):
@@ -454,8 +508,9 @@ def gather_blocks(pool_k, pool_v, block_ids):
 
 def _make_scatter():
     def scatter(pool_k, pool_v, block_ids, k_blocks, v_blocks):
-        pool_k = pool_k.at[:, block_ids].set(k_blocks.astype(pool_k.dtype))
-        pool_v = pool_v.at[:, block_ids].set(v_blocks.astype(pool_v.dtype))
+        put = lambda p, b: p.at[:, block_ids].set(b.astype(p.dtype))
+        pool_k = kv_map(put, pool_k, k_blocks)
+        pool_v = kv_map(put, pool_v, v_blocks)
         return pool_k, pool_v
 
     return jax.jit(scatter, donate_argnums=(0, 1))
@@ -530,18 +585,12 @@ def decode_step_paged(params, cfg: GPTConfig, pool_k, pool_v, page_table,
 
         def attend_cached(q, k, v, i=i):
             nonlocal new_k, new_v
-            new_k = new_k.at[i, blk, hidx, off].set(
-                k[:, :, 0, :].astype(new_k.dtype)
-            )
-            new_v = new_v.at[i, blk, hidx, off].set(
-                v[:, :, 0, :].astype(new_v.dtype)
-            )
+            new_k = _pool_write(new_k, (i, blk, hidx, off), k[:, :, 0, :])
+            new_v = _pool_write(new_v, (i, blk, hidx, off), v[:, :, 0, :])
             # virtual view: [B, MP, H, P, hd] -> [B, H, MP*P, hd]
             kv_shape = (b, cfg.num_heads, t_virt, k.shape[-1])
-            k_virt = new_k[i][page_table].transpose(0, 2, 1, 3, 4) \
-                .reshape(kv_shape).astype(q.dtype)
-            v_virt = new_v[i][page_table].transpose(0, 2, 1, 3, 4) \
-                .reshape(kv_shape).astype(q.dtype)
+            k_virt = _virt_view(new_k, i, page_table, kv_shape, q.dtype)
+            v_virt = _virt_view(new_v, i, page_table, kv_shape, q.dtype)
             return _attend(q, k_virt, v_virt, pos_mask), None
 
         x, _ = _block(cfg, p[f"layer_{i}"], x, attend_cached)
@@ -593,13 +642,11 @@ def verify_step_paged(params, cfg: GPTConfig, pool_k, pool_v, page_table,
         def attend_cached(q, k, v, i=i):
             nonlocal new_k, new_v
             # k/v: [B, H, n, hd] — n page-table-translated scatters at once
-            new_k = new_k.at[i, bidx3, hidx3, oidx3].set(k.astype(new_k.dtype))
-            new_v = new_v.at[i, bidx3, hidx3, oidx3].set(v.astype(new_v.dtype))
+            new_k = _pool_write(new_k, (i, bidx3, hidx3, oidx3), k)
+            new_v = _pool_write(new_v, (i, bidx3, hidx3, oidx3), v)
             kv_shape = (b, cfg.num_heads, t_virt, k.shape[-1])
-            k_virt = new_k[i][page_table].transpose(0, 2, 1, 3, 4) \
-                .reshape(kv_shape).astype(q.dtype)
-            v_virt = new_v[i][page_table].transpose(0, 2, 1, 3, 4) \
-                .reshape(kv_shape).astype(q.dtype)
+            k_virt = _virt_view(new_k, i, page_table, kv_shape, q.dtype)
+            v_virt = _virt_view(new_v, i, page_table, kv_shape, q.dtype)
             return _attend(q, k_virt, v_virt, pos_mask), None
 
         x, _ = _block(cfg, p[f"layer_{i}"], x, attend_cached)
@@ -670,8 +717,9 @@ def prefill_paged(params, cfg: GPTConfig, prompt_ids, prompt_lens,
     def to_pages(t):
         return t.reshape(chunked).transpose(0, 1, 3, 2, 4, 5)
 
-    pool_k = pool_k.at[:, page_rows].set(to_pages(k_stack).astype(pool_k.dtype))
-    pool_v = pool_v.at[:, page_rows].set(to_pages(v_stack).astype(pool_v.dtype))
+    idx = (slice(None), page_rows)
+    pool_k = _pool_write(pool_k, idx, to_pages(k_stack))
+    pool_v = _pool_write(pool_v, idx, to_pages(v_stack))
     return pool_k, pool_v, logits
 
 
@@ -727,10 +775,9 @@ def prefill_paged_cow(params, cfg: GPTConfig, suffix_ids, suffix_lens,
     oidx3 = off[:, None, :]                           # [B, 1, T]
     # k_stack/v_stack: [L, B, H, T, hd] — T individual (block, offset)
     # scatters per row, the verify-step idiom applied to prefill
-    pool_k = pool_k.at[:, bidx3, hidx3, oidx3].set(
-        k_stack.astype(pool_k.dtype))
-    pool_v = pool_v.at[:, bidx3, hidx3, oidx3].set(
-        v_stack.astype(pool_v.dtype))
+    idx = (slice(None), bidx3, hidx3, oidx3)
+    pool_k = _pool_write(pool_k, idx, k_stack)
+    pool_v = _pool_write(pool_v, idx, v_stack)
     return pool_k, pool_v, logits
 
 
@@ -780,12 +827,10 @@ def _prefill_suffix(params, cfg: GPTConfig, suffix_ids, suffix_lens,
 
         def attend_mixed(q, k, v, i=i):
             kv_shape = (b, num_heads, t_virt, k.shape[-1])
-            k_pref = pool_k[i][read_tables] \
-                .transpose(0, 2, 1, 3, 4).reshape(kv_shape)
-            v_pref = pool_v[i][read_tables] \
-                .transpose(0, 2, 1, 3, 4).reshape(kv_shape)
-            k_all = jnp.concatenate([k_pref.astype(k.dtype), k], axis=2)
-            v_all = jnp.concatenate([v_pref.astype(v.dtype), v], axis=2)
+            k_pref = _virt_view(pool_k, i, read_tables, kv_shape, k.dtype)
+            v_pref = _virt_view(pool_v, i, read_tables, kv_shape, v.dtype)
+            k_all = jnp.concatenate([k_pref, k], axis=2)
+            v_all = jnp.concatenate([v_pref, v], axis=2)
             mask = jnp.concatenate([pref_mask, self_mask], axis=-1)
             return _attend(q, k_all, v_all, mask), (k, v)
 

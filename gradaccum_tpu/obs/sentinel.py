@@ -27,6 +27,12 @@ a tick-heartbeat lease per replica, and detects four anomaly classes:
   tokens). The policy's own governor backs admission off first; this
   anomaly is the fleet-visible escalation, and its stock remediation
   routes the replica through recover + bounded requeue.
+- ``tier_thrash`` — a tiered-swap engine's windowed demotion rate pinned
+  above the ceiling: parked records are ping-ponging between the host
+  and disk rungs of the memory ladder (``memory/tiers.py``) faster than
+  they are being resumed — swap has stopped being cheaper than
+  re-prefill, and the host rung (``swap_max_bytes``) should grow or
+  admission should back off.
 
 - ``healer_frozen`` — terminal, raised BY the self-healing escalation
   ladder (``resilience/healer.py``) when it froze itself (flap or rung
@@ -71,13 +77,14 @@ SCALE_STORM = "scale_storm"
 ENGINE_FAULT = "engine_fault"
 DEGENERATE_DRAFT = "degenerate_draft"
 PREEMPTION_STORM = "preemption_storm"
+TIER_THRASH = "tier_thrash"
 # terminal: the self-healing ladder (resilience/healer.py) froze itself
 # (flap or rung exhaustion) and is waiting for an operator — automation
 # must never thrash, so this kind has NO automatic remediation
 HEALER_FROZEN = "healer_frozen"
 
 KINDS = (STALL, DEAD_REPLICA, LATENCY_CLIFF, SCALE_STORM, ENGINE_FAULT,
-         DEGENERATE_DRAFT, PREEMPTION_STORM, HEALER_FROZEN)
+         DEGENERATE_DRAFT, PREEMPTION_STORM, TIER_THRASH, HEALER_FROZEN)
 
 # default severity per kind: "warning" degrades service, "critical"
 # threatens it, "page" demands a human NOW (the ladder already gave up)
@@ -89,6 +96,7 @@ SEVERITY = {
     ENGINE_FAULT: "warning",
     DEGENERATE_DRAFT: "warning",
     PREEMPTION_STORM: "warning",
+    TIER_THRASH: "warning",
     HEALER_FROZEN: "page",
 }
 
@@ -186,6 +194,9 @@ class Sentinel:
         preempt_ceiling: float = 0.5,
         preempt_warmup: int = 8,
         preempt_consecutive: int = 8,
+        thrash_ceiling: float = 0.5,
+        thrash_warmup: int = 8,
+        thrash_consecutive: int = 8,
         check_interval: Optional[float] = None,
         severity: Optional[Dict[str, str]] = None,
     ):
@@ -208,6 +219,9 @@ class Sentinel:
         self.preempt_ceiling = float(preempt_ceiling)
         self.preempt_warmup = int(preempt_warmup)
         self.preempt_consecutive = int(preempt_consecutive)
+        self.thrash_ceiling = float(thrash_ceiling)
+        self.thrash_warmup = int(thrash_warmup)
+        self.thrash_consecutive = int(thrash_consecutive)
         self.check_interval = check_interval
         self._lock = threading.Lock()
         # replica key (None = the single engine) -> lease state
@@ -219,6 +233,8 @@ class Sentinel:
         self._accept_run: Dict[Optional[int], int] = {}
         self._preempt_n: Dict[Optional[int], int] = {}
         self._preempt_run: Dict[Optional[int], int] = {}
+        self._thrash_n: Dict[Optional[int], int] = {}
+        self._thrash_run: Dict[Optional[int], int] = {}
         self._severity = dict(SEVERITY)
         if severity:
             unknown = set(severity) - set(KINDS)
@@ -535,6 +551,36 @@ class Sentinel:
                         "ceiling": self.preempt_ceiling}, t)
         elif not high:
             self._resolve(PREEMPTION_STORM, replica, t)
+
+    def observe_tier_spills(self, rate: Optional[float],
+                            replica: Optional[int] = None,
+                            now: Optional[float] = None) -> None:
+        """Feed one tiered-swap engine's recent demotion rate
+        (host→disk demotions/tick over the serving metrics' 64-tick
+        window; None = no tiered store, ignored). A rate pinned above
+        ``thrash_ceiling`` for ``thrash_consecutive`` warmed samples
+        fires ``tier_thrash`` — the memory ladder is shuttling parked
+        records between rungs faster than resumes drain them, so swap
+        has stopped being cheaper than re-prefill. An operator should
+        grow the host rung (``swap_max_bytes``), grow the pool, or back
+        admission off. Recovery below the ceiling auto-resolves, same
+        level-held contract as every other kind."""
+        if rate is None:
+            return
+        t = self.clock() if now is None else float(now)
+        with self._lock:
+            n = self._thrash_n.get(replica, 0) + 1
+            self._thrash_n[replica] = n
+            high = (n > self.thrash_warmup
+                    and float(rate) > self.thrash_ceiling)
+            run = self._thrash_run.get(replica, 0) + 1 if high else 0
+            self._thrash_run[replica] = run
+        if high and run >= self.thrash_consecutive:
+            self._fire(TIER_THRASH, replica,
+                       {"demotion_rate": round(float(rate), 4),
+                        "ceiling": self.thrash_ceiling}, t)
+        elif not high:
+            self._resolve(TIER_THRASH, replica, t)
 
     def note_fault(self, error: str = "", replica: Optional[int] = None,
                    now: Optional[float] = None) -> None:

@@ -43,6 +43,7 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gradaccum_tpu.memory.quant import QuantTensor
 from gradaccum_tpu.ops import accumulation as acc
 from gradaccum_tpu.ops.adamw import Optimizer
 from gradaccum_tpu.parallel.mesh import DATA_AXIS
@@ -52,6 +53,23 @@ from gradaccum_tpu.utils.tree import tree_map_with_names
 
 # state fields holding optimizer slots (ScanState/StreamingState.opt_state)
 _MOMENT_PREFIX = "opt_state/"
+
+
+def _reject_quantized(state) -> None:
+    # q8 moments (ops.adamw moment_dtype="q8") flatten to QuantTensor
+    # children whose static original-shape aux would go stale under a
+    # row slice — sharding them would dequantize to the WRONG shape.
+    # Quantization and ZeRO-1 attack the same 2x params of moment memory;
+    # pick one per run.
+    leaves = jax.tree.leaves(state,
+                             is_leaf=lambda x: isinstance(x, QuantTensor))
+    if any(isinstance(l, QuantTensor) for l in leaves):
+        raise ValueError(
+            "ZeRO-1 cannot shard q8-quantized optimizer state "
+            "(moment_dtype='q8'): the blockwise codec's static shape "
+            "does not survive a per-rank slice — use moment_dtype='q8' "
+            "OR zero1, not both"
+        )
 
 
 def shard_dim(shape, n: int) -> Optional[int]:
@@ -84,6 +102,7 @@ def zero1_state_specs(
     ZeRO-1 layout: every leaf follows ``param_rules`` (default replicate),
     except rule-replicated optimizer-state leaves (moments AND master
     weights), which shard over ``axis`` per :func:`shard_dim`."""
+    _reject_quantized(state)
     return tree_map_with_names(
         lambda name, leaf: _zero1_spec(name, leaf, n, param_rules, axis), state
     )
@@ -93,6 +112,7 @@ def zero1_state_shardings(
     state, mesh: Mesh, param_rules: Rules | None = None, axis: str = DATA_AXIS
 ):
     """Tree of NamedShardings for the ZeRO-1 layout (GSPMD placement)."""
+    _reject_quantized(state)
     n = dict(mesh.shape)[axis]
     return tree_map_with_names(
         lambda name, leaf: NamedSharding(

@@ -99,9 +99,16 @@ def default_ladders(server=None, consensus=None,
     ``stall``             recover+requeue
     ``dead_replica``      targeted recover → replica drain (redispatch)
     ``preemption_storm``  governor pin → pool grow
+    ``tier_thrash``       governor pin → pool grow
     ``scale_storm``       checkpoint rollback (serving, if ``checkpoint``)
                           / drain consensus (training, if ``consensus``)
     ====================  =============================================
+
+    ``tier_thrash`` (memory/tiers.py spill churn) shares the
+    preemption-storm rungs on purpose: records ping-pong between the
+    host and disk rungs because too many requests are being parked,
+    so the cures are the same — admit less, or grow the pool so fewer
+    victims park at all.
     """
     ladders: Dict[str, List[remediation_lib.Remediation]] = {}
     if server is not None:
@@ -113,6 +120,8 @@ def default_ladders(server=None, consensus=None,
         ladders[obs_sentinel.STALL] = [recover]
         ladders[obs_sentinel.DEAD_REPLICA] = [recover, drain_rep]
         ladders[obs_sentinel.PREEMPTION_STORM] = [
+            remediation_lib.governor_pin_rung(server), grow]
+        ladders[obs_sentinel.TIER_THRASH] = [
             remediation_lib.governor_pin_rung(server), grow]
         if checkpoint is not None:
             ladders[obs_sentinel.SCALE_STORM] = [

@@ -106,6 +106,7 @@ def bind_default_remediations(sentinel, server=None, consensus=None):
     ``stall``                 ``server`` recover + bounded requeue
     ``dead_replica``          ``server`` recover + bounded requeue
     ``preemption_storm``      ``server`` recover + bounded requeue
+    ``tier_thrash``           ``server`` recover + bounded requeue
     ``scale_storm``           ``consensus`` drain request
     ``engine_fault``          (none — the fault handler already ran)
     (operator-bound)          :func:`request_reconfig` — e.g. bind
@@ -124,7 +125,8 @@ def bind_default_remediations(sentinel, server=None, consensus=None):
         remedy = recover_and_requeue(server)
         for kind in (obs_sentinel.LATENCY_CLIFF, obs_sentinel.STALL,
                      obs_sentinel.DEAD_REPLICA,
-                     obs_sentinel.PREEMPTION_STORM):
+                     obs_sentinel.PREEMPTION_STORM,
+                     obs_sentinel.TIER_THRASH):
             sentinel.on(kind, remedy)
     if consensus is not None:
         sentinel.on(obs_sentinel.SCALE_STORM, request_drain(consensus))

@@ -60,6 +60,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gradaccum_tpu.memory.radix import RadixIndex
 from gradaccum_tpu.models.gpt import GPTConfig
 from gradaccum_tpu.models.gpt_decode import (
     DecodeCache,
@@ -210,16 +211,19 @@ class PrefixCache:
         self.cow = bool(cow)
         self._by_hash: Dict[str, int] = {}   # chunk hash -> block id
         self._by_block: Dict[int, str] = {}  # block id -> its chunk hash
-        # partial-tail entries: hash of prompt[:full*P + t] -> [(block,
-        # t), ...]. Unlike full chunks (whose shared block outlives any
-        # single holder by refcount), the SAME sub-page content lives in
-        # many PRIVATE blocks (every fork copies it) — so each hash keeps
-        # every live backing block, first-registered first, and losing
-        # one holder never loses the entry while another block still
-        # carries the bytes. One block can back MANY tail lengths, so
-        # the reverse map holds the keys it appears under.
-        self._tail_by_hash: Dict[str, List[Tuple[int, int]]] = {}
-        self._tail_by_block: Dict[int, List[str]] = {}
+        # partial-tail entries live in a compressed radix tree over token
+        # content (memory/radix.py): position full*P + t of a prompt is
+        # marked (block, t) when ``block`` holds those ``t`` tokens at its
+        # head. The tree shares all common structure between prompts, so
+        # registration costs one node per DIVERGENCE instead of one hash
+        # per (prefix, t) — the O(tokens)-dicts-per-insert index PR 14
+        # flagged as fleet-hostile. Unlike full chunks (whose shared
+        # block outlives any single holder by refcount), the SAME
+        # sub-page content lives in many PRIVATE blocks (every fork
+        # copies it) — so each position keeps every live backing block,
+        # first-registered first, and losing one holder never loses the
+        # entry while another block still carries the bytes.
+        self._tails = RadixIndex()
 
     def __len__(self) -> int:
         # full-chunk entries only: the operator's "indexed chunks" gauge
@@ -228,8 +232,9 @@ class PrefixCache:
 
     @property
     def tail_count(self) -> int:
-        """Live sub-page (copy-on-write) tail entries."""
-        return len(self._tail_by_hash)
+        """Live sub-page (copy-on-write) tail entries — distinct marked
+        positions in the radix tree."""
+        return self._tails.mark_points
 
     def _keys(self, prompt: np.ndarray, n_chunks: int):
         """Yield the first ``n_chunks`` cumulative chunk keys in ONE pass:
@@ -280,6 +285,10 @@ class PrefixCache:
         prompt = np.asarray(prompt).reshape(-1)
         data = np.ascontiguousarray(prompt, np.int32)
         h = hashlib.sha1()
+        # the radix writer walks the SAME tokens the running sha1 hashes —
+        # the tree is keyed by content, so the two indexes can never name
+        # different prefixes for the same position
+        w = self._tails.writer() if self.cow else None
         for chunk, block in enumerate(blocks):
             block = int(block)
             base = chunk * self.page_size
@@ -288,17 +297,20 @@ class PrefixCache:
                 # chunk (an adopted shared prefix — the common case for a
                 # hot system prompt's followers) registered its sub-page
                 # entries when first inserted, so skipping the per-token
-                # walk keeps insert O(new tokens), not O(prompt)
+                # marking keeps insert O(new tokens), not O(prompt) — the
+                # writer still advances through the chunk (the path
+                # already exists, so it only walks, never builds)
                 probe = h.copy()
                 probe.update(data[base:base + self.page_size].tobytes())
                 if probe.hexdigest() in self._by_hash:
                     h = probe
+                    w.advance(data[base:base + self.page_size])
                     continue
                 for t in range(1, self.page_size):
-                    h.update(data[base + t - 1:base + t].tobytes())
-                    self._tail_register(h.copy().hexdigest(), block, t)
-                h.update(data[base + self.page_size - 1:
-                              base + self.page_size].tobytes())
+                    w.advance(data[base + t - 1])
+                    w.mark(block, t)
+                w.advance(data[base + self.page_size - 1])
+                h.update(data[base:base + self.page_size].tobytes())
             else:
                 h.update(data[base:base + self.page_size].tobytes())
             key = h.copy().hexdigest()
@@ -306,19 +318,6 @@ class PrefixCache:
                 continue
             self._by_hash[key] = block
             self._by_block[block] = key
-
-    def _tail_register(self, key: str, block: int, t: int) -> None:
-        """One sub-page entry: ``key`` (cumulative hash of the prompt's
-        first ``page*P + t`` tokens) is backed by ``block`` holding those
-        ``t`` tokens at its head. Every live backing block registers —
-        the same bytes live in many private forks, and the entry must
-        survive any single holder's retirement."""
-        block = int(block)
-        pairs = self._tail_by_hash.setdefault(key, [])
-        if any(p[0] == block for p in pairs):
-            return
-        pairs.append((block, t))
-        self._tail_by_block.setdefault(block, []).append(key)
 
     def insert_tail(self, prompt: np.ndarray, block: int) -> None:
         """Register the prompt's FINAL partial chunk as backed by
@@ -338,12 +337,10 @@ class PrefixCache:
         block = int(block)
         full = prompt.size // self.page_size
         data = np.ascontiguousarray(prompt, np.int32)
-        h = hashlib.sha1()
-        h.update(data[:full * self.page_size].tobytes())
+        w = self._tails.writer(data[:full * self.page_size])
         for t in range(1, rem + 1):
-            h.update(data[full * self.page_size + t - 1:
-                          full * self.page_size + t].tobytes())
-            self._tail_register(h.copy().hexdigest(), block, t)
+            w.advance(data[full * self.page_size + t - 1])
+            w.mark(block, t)
 
     def match_cow(self, prompt: np.ndarray
                   ) -> Tuple[List[int], Optional[int], int]:
@@ -370,9 +367,6 @@ class PrefixCache:
                           (chunk + 1) * self.page_size].tobytes())
             block = self._by_hash.get(h.copy().hexdigest())
             if block is None:
-                # rewind: the tail walk continues from the last full match
-                h = hashlib.sha1()
-                h.update(data[:chunk * self.page_size].tobytes())
                 break
             blocks.append(block)
         full = len(blocks)
@@ -380,11 +374,18 @@ class PrefixCache:
         rem = min(self.page_size - 1, prompt.size - start)
         tail_block: Optional[int] = None
         tail_tokens = 0
-        for t in range(1, rem + 1):
-            h.update(data[start + t - 1:start + t].tobytes())
-            hit = self._tail_by_hash.get(h.copy().hexdigest())
-            if hit:
-                tail_block, tail_tokens = hit[0][0], t
+        # the tail walk is a radix descent from the matched region: marks
+        # along an insert's chunk cover contiguous lengths 1..k (removals
+        # are wholesale or upper trims), so the first token divergence
+        # ends the longest match — no need to probe every length
+        r = self._tails.reader(data[:start])
+        if r is not None:
+            for t in range(1, rem + 1):
+                if not r.advance(data[start + t - 1]):
+                    break
+                pairs = r.marks()
+                if pairs:
+                    tail_block, tail_tokens = pairs[0][0], t
         return blocks, tail_block, tail_tokens
 
     def is_live(self, block: int) -> bool:
@@ -404,13 +405,7 @@ class PrefixCache:
         key = self._by_block.pop(int(block), None)
         if key is not None:
             self._by_hash.pop(key, None)
-        for key in self._tail_by_block.pop(int(block), []):
-            pairs = self._tail_by_hash.get(key)
-            if pairs is None:
-                continue
-            pairs[:] = [p for p in pairs if p[0] != int(block)]
-            if not pairs:
-                self._tail_by_hash.pop(key, None)
+        self._tails.forget(int(block))
 
     def trim_tail(self, block: int, max_tokens: int) -> None:
         """Drop every entry of ``block`` that covers MORE than
@@ -425,29 +420,12 @@ class PrefixCache:
             key = self._by_block.pop(int(block), None)
             if key is not None:
                 self._by_hash.pop(key, None)
-        keys = self._tail_by_block.get(int(block))
-        if not keys:
-            return
-        keep = []
-        for key in keys:
-            pairs = self._tail_by_hash[key]
-            mine = next(p for p in pairs if p[0] == int(block))
-            if mine[1] > int(max_tokens):
-                pairs.remove(mine)
-                if not pairs:
-                    self._tail_by_hash.pop(key, None)
-            else:
-                keep.append(key)
-        if keep:
-            self._tail_by_block[int(block)] = keep
-        else:
-            self._tail_by_block.pop(int(block), None)
+        self._tails.trim(int(block), int(max_tokens))
 
     def clear(self) -> None:
         self._by_hash.clear()
         self._by_block.clear()
-        self._tail_by_hash.clear()
-        self._tail_by_block.clear()
+        self._tails.clear()
 
 
 class PagedCachePool(_SlotLedger):

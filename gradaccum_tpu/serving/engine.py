@@ -51,6 +51,7 @@ from gradaccum_tpu.models.gpt_decode import (
     verify_step_paged,
     verify_step_ragged,
 )
+from gradaccum_tpu.memory.quant import QuantKV, is_quantized_kv, kv_map
 from gradaccum_tpu.obs import trace as obs_trace
 from gradaccum_tpu.resilience import faults
 from gradaccum_tpu.serving import admission as admission_lib
@@ -746,11 +747,28 @@ class Engine:
         # quantile/optimistic modes — overcommit is a BLOCK concept) and
         # with it the preempt -> park -> re-admit lifecycle.
         self.admission_policy = admission_lib.resolve_policy(admission)
-        if swap not in ("host", "recompute"):
+        if swap not in ("host", "recompute", "tiered"):
             raise ValueError(
-                f"swap must be 'host' or 'recompute', got {swap!r}"
+                f"swap must be 'host', 'recompute', or 'tiered', got {swap!r}"
             )
         self.swap_mode = swap
+        # int8 KV: the pool stores QuantKV pytrees (memory/quant.py) —
+        # paged layout only (the per-vector scale rides the block axis)
+        # and without speculation (the draft cache is fixed-layout)
+        self._kv_quant = (cache_dtype is not None
+                          and jnp.dtype(cache_dtype) == jnp.dtype(jnp.int8))
+        if self._kv_quant:
+            if page_size is None:
+                raise ValueError(
+                    "cache_dtype=int8 needs paged mode (page_size=...): "
+                    "the quantization scales live per pool block vector"
+                )
+            if speculate_k > 0:
+                raise ValueError(
+                    "cache_dtype=int8 does not compose with speculate_k: "
+                    "the draft cache is fixed-layout (use bf16 for "
+                    "speculative engines)"
+                )
         if (self.admission_policy is not None
                 and self.admission_policy.mode != "reserve"
                 and page_size is None):
@@ -764,8 +782,20 @@ class Engine:
         # of growing host memory without limit
         self.swap_max_bytes = (None if swap_max_bytes is None
                                else int(swap_max_bytes))
-        self._swap_store = (HostSwapStore(max_bytes=self.swap_max_bytes)
-                            if swap == "host" else None)
+        if swap == "host":
+            self._swap_store = HostSwapStore(max_bytes=self.swap_max_bytes)
+        elif swap == "tiered":
+            # memory/tiers.py ladder: host overflow demotes to disk
+            # (sha-checked on the way back) instead of evicting to
+            # re-prefill — swap_max_bytes caps the HOST rung only
+            from gradaccum_tpu.memory.tiers import TieredStore
+
+            self._swap_store = (
+                TieredStore(host_max_bytes=self.swap_max_bytes)
+                if self.swap_max_bytes is not None else TieredStore()
+            )
+        else:
+            self._swap_store = None
         # rid -> resume snapshot for parked (preempted) requests
         self._parked_state: Dict[int, _ParkedState] = {}
         # -- live reconfiguration (serving/reconfig.py) -----------------
@@ -1150,11 +1180,42 @@ class Engine:
                             else None),
             "swap": self.swap_mode,
             "swap_max_bytes": self.swap_max_bytes,
+            # memory-ladder shape (memory/): an int8 pool or a tiered
+            # swap store changes the bytes/token economics a redeploy
+            # must reproduce
+            "memory": {
+                "kv_quant": self._kv_quant,
+                "token_bytes": self._token_bytes,
+                "tiered_swap": self.swap_mode == "tiered",
+            },
             # the self-healing ladder policy this engine serves under
             # (set by ServingServer when a resilience/healer.py Healer is
             # attached); None = operator-driven remediation only
             "healer": getattr(self, "healer_knobs", None),
         }
+
+    def memory_stats(self) -> dict:
+        """The memory ladder's live footprint (``memory/``): bytes/token
+        at the pool's storage layout, quantized-bytes saved against the
+        model dtype, and — under ``swap="tiered"`` — the tier
+        occupancy/spill counters. Exported by ``ServingServer`` under
+        ``stats()["memory"]`` and scraped through ``/metrics``."""
+        if self.paged:
+            used_tokens = self.pool.allocated_blocks * self.page_size
+        else:
+            used_tokens = self.pool.active_count * self.max_len
+        out = {
+            "kv_quant": self._kv_quant,
+            "token_bytes": self._token_bytes,
+            "kv_bytes_in_use": used_tokens * self._token_bytes,
+        }
+        if self._kv_quant:
+            full = (2 * self.cfg.num_layers * self.cfg.hidden_size
+                    * jnp.dtype(self.cfg.dtype).itemsize)
+            out["kv_bytes_saved"] = used_tokens * (full - self._token_bytes)
+        if self.swap_mode == "tiered":
+            out["tiers"] = self._swap_store.stats()
+        return out
 
     # -- request intake ---------------------------------------------------
 
@@ -1299,6 +1360,10 @@ class Engine:
     def _token_bytes(self) -> int:
         """Pool bytes per cache position (K and V, all layers) at the
         pool's STORAGE dtype — a bf16 cache charges half per token."""
+        if self._kv_quant:
+            # int8 payload plus one f32 scale per (head, position) vector
+            return 2 * self.cfg.num_layers * (self.cfg.hidden_size
+                                              + self.cfg.num_heads * 4)
         dtype = (self.cfg.dtype if self.cache_dtype is None
                  else self.cache_dtype)
         return 2 * self.cfg.num_layers * self.cfg.hidden_size * \
@@ -1597,6 +1662,11 @@ class Engine:
             # after a storm without adding the gauge to engines that
             # never park anything
             gauges["swap_store_bytes"] = self._swap_store.held_bytes
+        if self.swap_mode == "tiered":
+            ts = self._swap_store.stats()
+            gauges.update(tier_disk_bytes=ts["disk_bytes"],
+                          tier_demotions=ts["demotions"],
+                          tier_promotions=ts["promotions"])
         self.metrics.record_tick(self.scheduler.depth, self.pool.active_count,
                                  self.pool.num_slots, **gauges)
         self._tick = t + 1
@@ -1847,8 +1917,8 @@ class Engine:
         ids = np.zeros((_block_bucket(n),), np.int32)
         ids[:n] = blocks
         kb, vb = gather_blocks(self.pool.k, self.pool.v, ids)
-        return (np.asarray(jax.device_get(kb))[:, :n],
-                np.asarray(jax.device_get(vb))[:, :n])
+        crop = lambda a: np.asarray(jax.device_get(a))[:, :n]
+        return kv_map(crop, kb), kv_map(crop, vb)
 
     def _host_set(self, arr, index, value, sharding):
         """Update one row of a small per-slot device array via a host
@@ -1885,7 +1955,14 @@ class Engine:
             if tail and all(pool.refcount(b) == 1
                             and pool.owner_of(b) == slot for b in tail):
                 kb, vb = self._gather_tail(tail)
-                arrays = {"k": kb, "v": vb}
+                if is_quantized_kv(kb):
+                    # swap records carry flat numpy arrays: split the
+                    # QuantKV pytree into payload + scale entries (the
+                    # resume path reassembles them)
+                    arrays = {"k_q": kb.q, "k_scale": kb.scale,
+                              "v_q": vb.q, "v_scale": vb.scale}
+                else:
+                    arrays = {"k": kb, "v": vb}
         else:
             arrays = {
                 "k": np.asarray(jax.device_get(self.pool.k[:, slot])),
@@ -2163,19 +2240,37 @@ class Engine:
         pool.alloc_to(slot, pk.length)
         n_pages = pool.blocks_for(pk.length)
         dst = [int(b) for b in pool.page_table[slot, pk.page_start:n_pages]]
-        kb, vb = rec.arrays["k"], rec.arrays["v"]
+        if self._kv_quant:
+            kb = QuantKV(rec.arrays["k_q"], rec.arrays["k_scale"])
+            vb = QuantKV(rec.arrays["v_q"], rec.arrays["v_scale"])
+        else:
+            kb, vb = rec.arrays["k"], rec.arrays["v"]
         assert len(dst) == kb.shape[1], "swap record / page-table mismatch"
         bucket = _block_bucket(len(dst))
         ids = np.full((bucket,), pool.num_blocks, np.int32)  # dropped pads
         ids[:len(dst)] = dst
-        pad = [(0, 0)] * kb.ndim
-        pad[1] = (0, bucket - kb.shape[1])
+
+        def _pad_pages(a):
+            # rank-aware: the QuantKV scale leaf is one rank lower than
+            # its payload, but pages ride axis 1 in both layouts
+            pad = [(0, 0)] * a.ndim
+            pad[1] = (0, bucket - a.shape[1])
+            return jnp.asarray(np.pad(a, pad))
+
         new_k, new_v = scatter_blocks(pool.k, pool.v, ids,
-                                      jnp.asarray(np.pad(kb, pad)),
-                                      jnp.asarray(np.pad(vb, pad)))
+                                      kv_map(_pad_pages, kb),
+                                      kv_map(_pad_pages, vb))
         if self._kv_sharding is not None:
-            new_k = jax.device_put(new_k, self._kv_sharding)
-            new_v = jax.device_put(new_v, self._kv_sharding)
+            if self._kv_quant:
+                # the f32 scale is one rank lower than the sharding spec;
+                # commit the payload placement, leave the scale replicated
+                new_k = QuantKV(jax.device_put(new_k.q, self._kv_sharding),
+                                new_k.scale)
+                new_v = QuantKV(jax.device_put(new_v.q, self._kv_sharding),
+                                new_v.scale)
+            else:
+                new_k = jax.device_put(new_k, self._kv_sharding)
+                new_v = jax.device_put(new_v, self._kv_sharding)
         rep = self._rep_sharding
         lengths = self._host_set(pool.lengths, slot, pk.length, rep)
         pool.set_arrays(new_k, new_v, lengths)
@@ -2372,6 +2467,9 @@ class Engine:
             # a fault mid-spec-tick can strand the draft cache half-written
             # (or donated-consumed) — it lives and dies with the pool
             device_arrays += [self._draft_k, self._draft_v]
+        # an int8 pool's k/v are QuantKV pytrees — flatten to raw buffers
+        # before the is_deleted probe
+        device_arrays = jax.tree_util.tree_leaves(device_arrays)
         if any(getattr(a, "is_deleted", lambda: False)() for a in device_arrays):
             num_slots = self.pool.num_slots
             if self.paged:

@@ -166,6 +166,15 @@ class ServingMetrics:
         self.swap_store_bytes = 0            # last sampled held_bytes
         self._g_swap_store = reg.gauge("serving/swap_store_bytes",
                                        labels=self._labels)
+        # memory-ladder plane (memory/tiers.py): last sampled cumulative
+        # tier counters plus a windowed per-tick demotion rate — the
+        # sentinel's tier_thrash feed (absent for non-tiered engines)
+        self.tier_disk_bytes = 0
+        self.tier_demotions = 0
+        self.tier_promotions = 0
+        self._g_tier_disk = reg.gauge("serving/tier_disk_bytes",
+                                      labels=self._labels)
+        self._tier_window = LatencySeries(window=64)  # demotions/tick
 
     # -- per-request lifecycle -------------------------------------------
 
@@ -314,6 +323,12 @@ class ServingMetrics:
         tick)."""
         return self._preempt_window.summary()["mean"]
 
+    def recent_tier_spill_rate(self) -> Optional[float]:
+        """Mean host→disk demotions/tick over the last 64 ticks — the
+        sentinel's ``tier_thrash`` feed (None before any tiered-swap
+        tick)."""
+        return self._tier_window.summary()["mean"]
+
     def record_token(self, request_id: int, first: bool) -> None:
         now = self.clock()
         if first and request_id in self._submit_t:
@@ -364,7 +379,10 @@ class ServingMetrics:
                     cow_shared_blocks: Optional[int] = None,
                     parked: Optional[int] = None,
                     preemptions: Optional[int] = None,
-                    swap_store_bytes: Optional[int] = None) -> None:
+                    swap_store_bytes: Optional[int] = None,
+                    tier_disk_bytes: Optional[int] = None,
+                    tier_demotions: Optional[int] = None,
+                    tier_promotions: Optional[int] = None) -> None:
         self.ticks += 1
         self.queue_depth.add(queue_depth)
         self.occupancy.add(active_slots / num_slots)
@@ -419,6 +437,21 @@ class ServingMetrics:
             self.swap_store_bytes = int(swap_store_bytes)
             self._g_swap_store.set(float(swap_store_bytes))
             scalars["serving/swap_store_bytes"] = float(swap_store_bytes)
+        if tier_disk_bytes is not None:
+            self.tier_disk_bytes = int(tier_disk_bytes)
+            self._g_tier_disk.set(float(tier_disk_bytes))
+            scalars["serving/tier_disk_bytes"] = float(tier_disk_bytes)
+        if tier_demotions is not None:
+            # the engine passes the store's CUMULATIVE counter; the window
+            # eats per-tick deltas so the rate decays once a spill storm
+            # passes (same resolve contract as the preemption window)
+            self._tier_window.add(max(0, int(tier_demotions)
+                                      - self.tier_demotions))
+            self.tier_demotions = int(tier_demotions)
+            scalars["serving/tier_demotions"] = float(tier_demotions)
+        if tier_promotions is not None:
+            self.tier_promotions = int(tier_promotions)
+            scalars["serving/tier_promotions"] = float(tier_promotions)
         # one call: records every scalar as a registry gauge AND streams to
         # the EventWriter when one is attached (replica-labeled in a fleet)
         self.registry.publish(scalars, step=self.ticks, labels=self._labels)
@@ -481,6 +514,9 @@ class ServingMetrics:
             "swap_bytes_out": self.swap_bytes_out,
             "swap_bytes_in": self.swap_bytes_in,
             "swap_store_bytes": self.swap_store_bytes,
+            "tier_disk_bytes": self.tier_disk_bytes,
+            "tier_demotions": self.tier_demotions,
+            "tier_promotions": self.tier_promotions,
             "parked_peak": self.parked_peak,
             "reconfigs": dict(self.reconfigs),
             "reconfigs_by_initiator": dict(self.reconfigs_by_initiator),

@@ -785,6 +785,10 @@ class ServingServer:
                 "records": len(store),
                 "evictions": store.evictions,
             }
+        if hasattr(engine, "memory_stats"):
+            # the memory ladder's live view (memory/): bytes/token at the
+            # storage layout, quantized savings, tier occupancy/spills
+            out["memory"] = engine.memory_stats()
         last = getattr(engine, "last_reconfig", None)
         if last is not None:
             out["last_reconfig"] = last.to_dict()
@@ -1199,6 +1203,12 @@ class ServingServer:
                                 self._engine.metrics
                                 .recent_preemption_rate(),
                                 replica=self._engine.replica_id)
+                        if getattr(self._engine, "swap_mode",
+                                   None) == "tiered":
+                            snt.observe_tier_spills(
+                                self._engine.metrics
+                                .recent_tier_spill_rate(),
+                                replica=self._engine.replica_id)
                     else:
                         # per-replica accept/preemption rates: one
                         # replica's stale draft (or thrashing pool) must
@@ -1212,6 +1222,10 @@ class ServingServer:
                                        None) is not None:
                                 snt.observe_preemptions(
                                     e.metrics.recent_preemption_rate(),
+                                    replica=e.replica_id)
+                            if getattr(e, "swap_mode", None) == "tiered":
+                                snt.observe_tier_spills(
+                                    e.metrics.recent_tier_spill_rate(),
                                     replica=e.replica_id)
                     snt.observe_tick(time.monotonic() - t0)
                     snt.check()
@@ -1327,6 +1341,9 @@ class ServingServer:
                     if getattr(eng, "admission_policy", None) is not None:
                         snt.observe_preemptions(
                             eng.metrics.recent_preemption_rate(), replica=i)
+                    if getattr(eng, "swap_mode", None) == "tiered":
+                        snt.observe_tier_spills(
+                            eng.metrics.recent_tier_spill_rate(), replica=i)
                     snt.check()
                 if self._healer is not None:
                     self._healer.poll()

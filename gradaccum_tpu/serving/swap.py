@@ -126,8 +126,8 @@ class HostSwapStore:
             if rec.nbytes > self.max_bytes:
                 raise SwapCapacityError(
                     f"swap record for request {rid} is {rec.nbytes} bytes "
-                    f"but the store caps at {self.max_bytes} — resuming by "
-                    "re-prefill instead"
+                    f"but the store holds {self._held} of {self.max_bytes} "
+                    "allowed — resuming by re-prefill instead"
                 )
             # replacing an existing record must not count the old bytes
             self.discard(rid)
@@ -154,6 +154,13 @@ class HostSwapStore:
             )
         self.bytes_in += rec.nbytes
         return rec
+
+    def peek(self, rid: int) -> SwapRecord:
+        """Unverified fetch for intra-ladder moves (``memory/tiers.py``
+        demoting host records to disk): no fault hook, no digest check,
+        no ``bytes_in`` accounting — the record is not leaving the
+        ladder, just changing rungs. Raises KeyError for unknown rids."""
+        return self._recs[int(rid)]
 
     def discard(self, rid: int) -> bool:
         rec = self._recs.pop(int(rid), None)

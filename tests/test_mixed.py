@@ -110,6 +110,36 @@ def test_silent_moment_downcast_raises_explicit_cast_allowed(rng):
     assert new_p["w"].dtype == jnp.bfloat16
 
 
+def test_q8_moment_dtype_rides_the_same_contract():
+    """The memory ladder's q8 rung enters through the SAME explicit
+    moment_dtype knob: state carries blockwise QuantTensor moments, the
+    fused-accumulation hooks are structurally absent (the AdamA window
+    cannot fold into quantized moments), and master_dtype composes —
+    masters stay f32 while m/v quantize."""
+    from gradaccum_tpu.memory.quant import QuantTensor
+
+    p = _mlp_params()
+    g = jax.tree.map(jnp.ones_like, p)
+    for factory in (adamw, adam):
+        opt = factory(1e-2, moment_dtype="q8")
+        assert opt.fused is None
+        state = opt.init(p)
+        assert isinstance(state.m["w"], QuantTensor)
+        assert state.m["w"].q.dtype == jnp.int8
+        new_p, new_state = opt.update(g, state, p, 0)
+        assert isinstance(new_state.v["w"], QuantTensor)
+        assert new_p["w"].dtype == jnp.float32
+    # q8 moments under f32 masters: the master tree stays full precision
+    bp = tree_cast_floating(p, jnp.bfloat16)
+    opt = adamw(1e-2, master_dtype=jnp.float32, moment_dtype="q8")
+    state = opt.init(bp)
+    assert isinstance(state, MasterAdamState)
+    assert state.master["w"].dtype == jnp.float32
+    assert isinstance(state.m["w"], QuantTensor)
+    new_p, _ = opt.update(tree_cast_floating(g, jnp.bfloat16), state, bp, 0)
+    assert new_p["w"].dtype == jnp.bfloat16
+
+
 def test_master_weights_accumulate_sub_ulp_updates():
     """lr small enough that one update is far below the bf16 ULP at 1.0:
     the f32 masters must still integrate every step (tracking the all-f32

@@ -169,6 +169,43 @@ def _memory_column(data) -> str:
     return "opt+accum/param " + " → ".join(parts)
 
 
+def _state_ladder_column(data) -> str:
+    """Render BENCH_mem.json's ``optimizer_state_ladder`` as the
+    f32 → q8 → adam_mini+q8 state-bytes/param progression."""
+    rows = data.get("optimizer_state_ladder")
+    if not isinstance(rows, list) or not rows:
+        return ""
+    try:
+        parts = [
+            f"{r['config']} {float(r['state_bytes_per_param']):g}B"
+            for r in rows
+        ]
+        ladder = float(rows[-1]["ladder_vs_f32"])
+    except (KeyError, TypeError, ValueError):
+        return ""
+    return "state/param " + " → ".join(parts) + f" ({ladder:.2f}x)"
+
+
+def _kv_stream_column(data) -> str:
+    """Render BENCH_mem.json's ``kv_stream_ladder`` as RAM bytes per
+    drain-resumable stream, baseline → ladder."""
+    rows = data.get("kv_stream_ladder")
+    if not isinstance(rows, list) or len(rows) < 2:
+        return ""
+    try:
+        parts = [
+            f"{r['config']} {float(r['ram_bytes_per_resumable_stream']):g}B"
+            for r in rows
+        ]
+        ladder = float(data.get("kv_ram_per_stream_ladder_vs_bf16", 0))
+    except (KeyError, TypeError, ValueError):
+        return ""
+    out = "KV RAM/stream " + " → ".join(parts)
+    if ladder:
+        out += f" ({ladder:.2f}x)"
+    return out
+
+
 def collect(bench_dir: str):
     """One record per BENCH_*.json: name, headline, acceptance (or None).
     MULTICHIP_r*.json dryrun artifacts ride along: ok -> PASS, skipped ->
@@ -199,6 +236,8 @@ def collect(bench_dir: str):
             "scaling": _scaling_column(data) or None,
             "overhead": _overhead_column(data) or None,
             "memory": _memory_column(data) or None,
+            "state_ladder": _state_ladder_column(data) or None,
+            "kv_stream": _kv_stream_column(data) or None,
             "spec": _spec_column(data) or None,
             "admission": _admission_column(data) or None,
             "cow": _cow_column(data) or None,
@@ -270,6 +309,10 @@ def main(argv=None) -> int:
                 detail += f" — {r['overhead']}"
             if r.get("memory"):
                 detail += f" — {r['memory']}"
+            if r.get("state_ladder"):
+                detail += f" — {r['state_ladder']}"
+            if r.get("kv_stream"):
+                detail += f" — {r['kv_stream']}"
             if r.get("spec"):
                 detail += f" — {r['spec']}"
             if r.get("admission"):
