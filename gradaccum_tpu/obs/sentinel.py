@@ -228,6 +228,10 @@ class Sentinel:
         self._hb: Dict[Optional[int], Tuple[float, Optional[int], bool]] = {}
         self._tick_base: Dict[Optional[int], RollingBaseline] = {}
         self._cliff_run: Dict[Optional[int], int] = {}
+        # latency_cliff samples dropped because the replica's heartbeat
+        # lease was already paging (stall/dead_replica) — one silence must
+        # not double-page as two anomalies
+        self.deduped_cliffs = 0
         self._scales: deque = deque()  # (t, scale)
         self._accept_n: Dict[Optional[int], int] = {}
         self._accept_run: Dict[Optional[int], int] = {}
@@ -451,6 +455,16 @@ class Sentinel:
         t = self.clock() if now is None else float(now)
         with self._lock:
             if self._maintenance:
+                return
+            lease_kind = STALL if replica is None else DEAD_REPLICA
+            if (lease_kind, replica) in self._firing:
+                # this replica's silence is ALREADY paging as a lease
+                # expiry — the giant duration sample a wedged loop
+                # eventually reports is the same cause, and firing a
+                # cliff on top would double-page it (and poison the
+                # baseline against the replica's eventual recovery)
+                self._cliff_run[replica] = 0
+                self.deduped_cliffs += 1
                 return
             base = self._tick_base.get(replica)
             if base is None:

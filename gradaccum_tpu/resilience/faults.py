@@ -34,6 +34,12 @@ Fault points (the ``index`` each site passes):
   ``crash`` at either index lands in a clean old-or-new configuration —
   never a torn pool — and the parked requests drain through the ordinary
   resume path.
+- ``FLEET_STEP`` — inside the fleet supervisor's membership poll; index =
+  supervision poll count. The home of the fleet fault kinds below:
+  ``replica_kill`` / ``replica_wedge`` / ``lease_partition`` aim at
+  ``FaultSpec.target`` (a replica index) and are applied by the
+  supervisor itself (the call site reads the matched spec via
+  :func:`fire_spec` — these kinds corrupt MEMBERSHIP state, not data).
 
 Kinds: ``crash`` raises :class:`InjectedCrash` (simulated process death —
 deliberately NOT an OSError, so IO retry loops never swallow it);
@@ -48,7 +54,14 @@ seconds at the fault point (a wedged-but-not-dead dispatch — what the
 serving watchdog exists to break) and then lets the call proceed;
 ``corrupt`` returns the kind string for the call site to corrupt its own
 state (the paged engine pokes a page-table row — bookkeeping corruption,
-as opposed to the data corruption of ``nan``/``inf``).
+as opposed to the data corruption of ``nan``/``inf``); the fleet kinds
+``replica_kill`` (the member dies: stops ticking AND stops renewing its
+liveness lease), ``replica_wedge`` (alive but stuck: the member stops
+making progress and heartbeating while its process lingers), and
+``lease_partition`` (the member keeps serving but its lease renewals are
+DROPPED — a registry-side partition, the false-positive the probe step
+exists to catch) return their spec for the fleet supervisor to apply to
+``FaultSpec.target``.
 
 When no injector is installed every hook is one global load + compare —
 nothing here touches the hot path in production.
@@ -71,8 +84,9 @@ MID_DECODE_TICK = "mid_decode_tick"
 MID_SWAP_IO = "mid_swap_io"
 POOL_PAGE_TABLE = "pool_page_table"
 MID_RECONFIG = "mid_reconfig"
+FLEET_STEP = "fleet_step"
 POINTS = (PRE_TRAIN_STEP, POST_TRAIN_STEP, MID_CKPT_WRITE, MID_DECODE_TICK,
-          MID_SWAP_IO, POOL_PAGE_TABLE, MID_RECONFIG)
+          MID_SWAP_IO, POOL_PAGE_TABLE, MID_RECONFIG, FLEET_STEP)
 
 KIND_CRASH = "crash"
 KIND_IO_ERROR = "io_error"
@@ -81,10 +95,17 @@ KIND_INF = "inf"
 KIND_OVERFLOW_STORM = "overflow_storm"
 KIND_SLOW_TICK = "slow_tick"
 KIND_CORRUPT = "corrupt"
+KIND_REPLICA_KILL = "replica_kill"
+KIND_REPLICA_WEDGE = "replica_wedge"
+KIND_LEASE_PARTITION = "lease_partition"
 KINDS = (KIND_CRASH, KIND_IO_ERROR, KIND_NAN, KIND_INF,
-         KIND_OVERFLOW_STORM, KIND_SLOW_TICK, KIND_CORRUPT)
+         KIND_OVERFLOW_STORM, KIND_SLOW_TICK, KIND_CORRUPT,
+         KIND_REPLICA_KILL, KIND_REPLICA_WEDGE, KIND_LEASE_PARTITION)
 # kinds whose firing corrupts the caller's data via corrupt_batch
 DATA_KINDS = (KIND_NAN, KIND_INF, KIND_OVERFLOW_STORM)
+# kinds the fleet supervisor applies to FaultSpec.target (membership
+# corruption — they only make sense at the FLEET_STEP point)
+FLEET_KINDS = (KIND_REPLICA_KILL, KIND_REPLICA_WEDGE, KIND_LEASE_PARTITION)
 
 
 class InjectedCrash(RuntimeError):
@@ -115,7 +136,8 @@ class FaultSpec:
     succeed. ``span`` widens the match to the ``span`` consecutive indices
     ``[at, at + span)`` — the burst shape of ``overflow_storm`` (its count
     defaults to its span so the whole burst fires). ``delay`` is the
-    ``slow_tick`` sleep in seconds.
+    ``slow_tick`` sleep in seconds. ``target`` aims a fleet kind at one
+    replica index (the supervisor applies the fault to that member).
     """
 
     point: str
@@ -124,6 +146,7 @@ class FaultSpec:
     count: Optional[int] = None
     span: int = 1
     delay: float = 0.0
+    target: Optional[int] = None
 
     def __post_init__(self):
         if self.point not in POINTS:
@@ -141,6 +164,19 @@ class FaultSpec:
             raise ValueError(f"count must be >= 1, got {self.count}")
         if self.kind == KIND_SLOW_TICK and self.delay <= 0:
             raise ValueError("slow_tick needs delay > 0 (seconds)")
+        if self.kind in FLEET_KINDS:
+            if self.point != FLEET_STEP:
+                raise ValueError(
+                    f"{self.kind} only fires at {FLEET_STEP!r} (it corrupts "
+                    "fleet membership, which only the supervisor can apply)"
+                )
+            if self.target is None or self.target < 0:
+                raise ValueError(
+                    f"{self.kind} needs target= (the replica index to hit)"
+                )
+        elif self.target is not None:
+            raise ValueError(
+                f"target= only applies to the fleet kinds {FLEET_KINDS}")
 
 
 class FaultSchedule:
@@ -164,11 +200,21 @@ class FaultSchedule:
         specs = []
         for _ in range(n_faults):
             kind = kinds[int(rng.integers(len(kinds)))]
+            point = points[int(rng.integers(len(points)))]
+            # fleet kinds only fire at the membership poll and need a
+            # victim; pin both so a mixed-kind draw pool stays valid
+            # (the point draw above still happens, keeping the rng
+            # stream — and thus every non-fleet spec — seed-stable)
+            target = None
+            if kind in FLEET_KINDS:
+                point = FLEET_STEP
+                target = 0
             specs.append(FaultSpec(
-                point=points[int(rng.integers(len(points)))],
+                point=point,
                 at=int(rng.integers(index_range[0], index_range[1])),
                 kind=kind,
                 delay=0.05 if kind == KIND_SLOW_TICK else 0.0,
+                target=target,
             ))
         return cls(specs)
 
@@ -214,6 +260,13 @@ class FaultInjector:
         self._lock = threading.Lock()  # ckpt writer + engine threads both fire
 
     def fire(self, point: str, index: int) -> Optional[str]:
+        spec = self.fire_spec(point, index)
+        return None if spec is None else spec.kind
+
+    def fire_spec(self, point: str, index: int) -> Optional[FaultSpec]:
+        """Like :meth:`fire` but returns the matched SPEC — call sites
+        that need the fault's parameters beyond its kind (the fleet
+        supervisor reads ``target``) use this form."""
         with self._lock:
             spec = self.schedule.match(point, index)
             if spec is None:
@@ -236,8 +289,9 @@ class FaultInjector:
             # a wedged-but-alive dispatch: stall OUTSIDE the lock (other
             # threads' fault points must stay live), then proceed normally
             time.sleep(spec.delay)
-            return spec.kind
-        return spec.kind  # data kinds: the call site corrupts its own data
+            return spec
+        # data/corrupt/fleet kinds: the call site applies the spec itself
+        return spec
 
 
 _ACTIVE: Optional[FaultInjector] = None
@@ -272,6 +326,14 @@ def fire(point: str, index: int) -> Optional[str]:
     if _ACTIVE is None:
         return None
     return _ACTIVE.fire(point, index)
+
+
+def fire_spec(point: str, index: int) -> Optional[FaultSpec]:
+    """Spec-returning hook (fleet supervision reads ``target`` off it).
+    No injector installed: one load + compare."""
+    if _ACTIVE is None:
+        return None
+    return _ACTIVE.fire_spec(point, index)
 
 
 def corrupt_batch(batch, kind: str):

@@ -97,12 +97,20 @@ def default_ladders(server=None, consensus=None,
     ====================  =============================================
     ``latency_cliff``     recover+requeue → replica drain → pool grow
     ``stall``             recover+requeue
-    ``dead_replica``      targeted recover → replica drain (redispatch)
+    ``dead_replica``      targeted recover → replica EXCISE (proof-gated
+                          removal + survivor re-dispatch) → replica ADD
+                          (provision replacement capacity)
     ``preemption_storm``  governor pin → pool grow
     ``tier_thrash``       governor pin → pool grow
     ``scale_storm``       checkpoint rollback (serving, if ``checkpoint``)
                           / drain consensus (training, if ``consensus``)
     ====================  =============================================
+
+    The ``dead_replica`` ladder is deliberately ordered detect → remove
+    → replace: a recover that sticks ends it cheaply; an excise only
+    lands when the membership registry can PROVE the member dead (a
+    partitioned-but-alive replica refuses the excise and the ladder
+    moves past it); the add rung restores fleet width either way.
 
     ``tier_thrash`` (memory/tiers.py spill churn) shares the
     preemption-storm rungs on purpose: records ping-pong between the
@@ -118,7 +126,10 @@ def default_ladders(server=None, consensus=None,
             server, factor=pool_grow_factor, max_blocks=max_blocks)
         ladders[obs_sentinel.LATENCY_CLIFF] = [recover, drain_rep, grow]
         ladders[obs_sentinel.STALL] = [recover]
-        ladders[obs_sentinel.DEAD_REPLICA] = [recover, drain_rep]
+        ladders[obs_sentinel.DEAD_REPLICA] = [
+            recover,
+            remediation_lib.excise_replica_rung(server),
+            remediation_lib.add_replica_rung(server)]
         ladders[obs_sentinel.PREEMPTION_STORM] = [
             remediation_lib.governor_pin_rung(server), grow]
         ladders[obs_sentinel.TIER_THRASH] = [

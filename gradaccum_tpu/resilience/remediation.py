@@ -280,6 +280,97 @@ def drain_replica_rung(server,
                        verify_window=verify_window)
 
 
+def excise_replica_rung(server, replace: bool = True,
+                        max_replicas: Optional[int] = None,
+                        verify_window: Optional[float] = None
+                        ) -> Remediation:
+    """EXCISE the anomalous replica: a drain's fleet-supervision twin for
+    a member the membership registry holds at DEAD (lease expired AND
+    probe failed). The reconfig plane proves departure with one
+    partial-consensus round the corpse cannot vote in, rebinds its
+    displaced streams across survivors, and decommissions its dispatch
+    slot — terminal for the member, recoverable for its work. The
+    reconfig REFUSES (ok=False → ``escalate("degraded")``) when the
+    member is not provably dead — a partitioned-but-alive replica's
+    probe keeps it SUSPECT, so the ladder escalates to capacity instead
+    of killing live streams.
+
+    With ``replace`` (the default) a SUCCESSFUL excise chains a
+    ``replica_add`` to restore the fleet's width — removal resolves the
+    anomaly, so the ladder never escalates to its own add rung on the
+    success path; the replacement must ride the heal itself. Bounded by
+    the same ``max_replicas`` cap as :func:`add_replica_rung`.
+    Fleet-only, needs a named replica."""
+
+    def applies(anomaly):
+        return (anomaly.replica is not None
+                and hasattr(server._engine, "replicas"))
+
+    def apply(anomaly, escalate=None):
+        from gradaccum_tpu.serving import reconfig as reconfig_lib
+
+        if not applies(anomaly):
+            return False
+        fut = server.request_reconfig(reconfig_lib.replica_excise(
+            anomaly.replica, initiator="healer"))
+        _watch_reconfig(fut, escalate)
+        if replace:
+            def chain(f):
+                try:
+                    if f.exception() is not None \
+                            or getattr(f.result(), "ok", True) is False:
+                        return  # refused/failed: the ladder escalates
+                except Exception:  # noqa: BLE001 — cancelled
+                    return
+                if not _below_add_cap(server._engine, max_replicas):
+                    return
+                server.request_reconfig(
+                    reconfig_lib.replica_add(initiator="healer"))
+
+            fut.add_done_callback(chain)
+
+    return Remediation("replica_excise", apply, applies=applies,
+                       verify_window=verify_window)
+
+
+def _below_add_cap(engine, max_replicas: Optional[int]) -> bool:
+    """Autonomous scale-out stays bounded: default cap is the fleet's
+    construction width + 2 (unbounded self-provisioning is how
+    automation eats a machine)."""
+    cap = (max_replicas if max_replicas is not None
+           else engine._generations[0][1] + 2)
+    return len(engine.active_replicas) < cap
+
+
+def add_replica_rung(server, max_replicas: Optional[int] = None,
+                     verify_window: Optional[float] = None) -> Remediation:
+    """Provision one NEW replica into the live fleet — the capacity rung
+    above excision: after a member is removed (or when one cannot be),
+    restore the fleet's width instead of running short-handed. Bounded
+    by ``max_replicas`` (default: the fleet's construction size + 2) —
+    unbounded autonomous scale-out is how automation eats a machine.
+    Fleet-only."""
+
+    def applies(anomaly):
+        return hasattr(server._engine, "replicas")
+
+    def apply(anomaly, escalate=None):
+        from gradaccum_tpu.serving import reconfig as reconfig_lib
+
+        engine = server._engine
+        if not hasattr(engine, "replicas"):
+            return False
+        if not _below_add_cap(engine, max_replicas):
+            return False  # at the scale-out cap: nothing to do
+        _watch_reconfig(
+            server.request_reconfig(reconfig_lib.replica_add(
+                initiator="healer")),
+            escalate)
+
+    return Remediation("replica_add", apply, applies=applies,
+                       verify_window=verify_window)
+
+
 def pool_grow_rung(server, factor: float = 1.5,
                    max_blocks: Optional[int] = None,
                    verify_window: Optional[float] = None) -> Remediation:

@@ -51,7 +51,17 @@ two independent axes: ``Engine(mesh=...)`` tensor-shards one engine's
 compiled tick over a serving mesh (weights Megatron-style, the paged pool
 on its BLOCK axis), and ``ReplicatedEngine`` (``replicated``) places N
 data-parallel engines — least-loaded dispatch, prefix-affinity routing,
-per-replica failure domains — behind the same server surface.
+per-replica failure domains — behind the same server surface. The fleet
+is SUPERVISED (``fleet``): every member holds a liveness lease renewed
+from its tick heartbeat; a stale lease turns it SUSPECT (new admissions
+stop, waiting work hedges to siblings), an expired lease plus a failed
+probe turns it DEAD, and ``replica_excise`` removes a DEAD member behind
+a partial-consensus proof the corpse cannot vote in — its streams rebind
+across survivors token-for-token. ``replica_add`` provisions a NEW
+member into the live fleet (the request-id lattice widens by generation;
+in-flight ids keep their owner) behind a warm-up admission ramp, and
+``pool_resize`` to a larger paged pool takes the zero-preemption
+INCREMENTAL grow path (a second block segment; nobody parks).
 """
 
 from gradaccum_tpu.serving.admission import (
@@ -65,6 +75,7 @@ from gradaccum_tpu.serving.cache_pool import (
     PrefixCache,
 )
 from gradaccum_tpu.serving.engine import Engine, StepEvents
+from gradaccum_tpu.serving.fleet import ExciseProof, FleetSupervisor
 from gradaccum_tpu.serving.reconfig import (
     ReconfigError,
     ReconfigResult,
@@ -72,7 +83,9 @@ from gradaccum_tpu.serving.reconfig import (
     checkpoint_swap,
     pool_resize,
     replica_activate,
+    replica_add,
     replica_drain,
+    replica_excise,
 )
 from gradaccum_tpu.serving.swap import HostSwapStore, SwapCapacityError, SwapError
 from gradaccum_tpu.serving.metrics import ServingMetrics
@@ -96,13 +109,17 @@ __all__ = [
     "SwapError",
     "Engine",
     "StepEvents",
+    "ExciseProof",
+    "FleetSupervisor",
     "ReconfigError",
     "ReconfigResult",
     "ReconfigSpec",
     "checkpoint_swap",
     "pool_resize",
     "replica_activate",
+    "replica_add",
     "replica_drain",
+    "replica_excise",
     "ReplicatedEngine",
     "ServingMetrics",
     "QueueFull",

@@ -470,7 +470,15 @@ class PagedCachePool(_SlotLedger):
             )
         self.k, self.v = init_paged_pool(cfg, num_blocks, page_size,
                                          cache_dtype=cache_dtype)
+        # kept for incremental grow: a second segment's arrays must match
+        # the model geometry this pool was built with
+        self._cfg = cfg
         self.cache_dtype = cache_dtype
+        # block-pool segments, in grow order (segment 0 = construction
+        # size). Block ids are contiguous across segments — segment s
+        # starts at sum(segments[:s]) — so the page table addresses both
+        # through the same int32 ids with no translation
+        self.segments: List[int] = [int(num_blocks)]
         self.lengths = jnp.zeros((num_slots,), jnp.int32)
         self.max_len = max_len
         self.page_size = page_size
@@ -723,6 +731,44 @@ class PagedCachePool(_SlotLedger):
         if self._decref(old, slot):
             self._reclaim([old])
         return old
+
+    def grow(self, extra_blocks: int) -> int:
+        """Append a SECOND block-pool segment of ``extra_blocks`` blocks —
+        the zero-preemption grow. The new blocks concatenate onto the
+        existing arrays' block axis (ids ``num_blocks..num_blocks+extra-1``
+        address them through the same page table: block ids are data, never
+        shapes, so every gather/scatter/swap/fork path translates with no
+        code change), live slots keep their state untouched, and only the
+        SENTINEL moves: unassigned page-table entries held the old
+        ``num_blocks``, which after the append would name the first new
+        block, so they are remapped to the new total (real ids are all
+        strictly below the old count — the remap can never touch one).
+        Returns the new total block count.
+
+        The shape change recompiles the decode/admit programs at the next
+        dispatch — one compile, no preemption, no quiesce — which is the
+        whole point vs the rebuild-everything resize path."""
+        extra = int(extra_blocks)
+        if extra < 1:
+            raise ValueError(f"grow needs at least one block, got {extra}")
+        old = self.num_blocks
+        extra_k, extra_v = init_paged_pool(self._cfg, extra, self.page_size,
+                                           cache_dtype=self.cache_dtype)
+        cat = lambda a, b: jnp.concatenate([a, b], axis=1)
+        self.k = jax.tree.map(cat, self.k, extra_k)
+        self.v = jax.tree.map(cat, self.v, extra_v)
+        total = old + extra
+        # sentinel remap BEFORE publishing the new count: every entry that
+        # said "no block" must keep saying it in the widened id space
+        self.page_table[self.page_table == old] = total
+        self.num_blocks = total
+        self.segments.append(extra)
+        self._free_blocks.extend(range(total - 1, old - 1, -1))
+        self._free_blocks.sort(reverse=True)  # lowest block still pops first
+        self._block_refs.extend([0] * extra)
+        self._block_owner.extend([None] * extra)
+        self._table_device = None
+        return total
 
     def page_table_device(self) -> jnp.ndarray:
         """Device copy of the page table, memoized: re-uploaded only after

@@ -1219,6 +1219,21 @@ class Engine:
 
     # -- request intake ---------------------------------------------------
 
+    def rebase_ids(self, id_start: int, id_stride: int) -> None:
+        """Move this engine onto a WIDER id lattice (live replica ADD):
+        future rids issue from ``id_start`` with ``id_stride`` — the new
+        fleet modulus — while every already-issued rid keeps routing
+        through the generation that minted it. ``id_start`` must not
+        re-issue: it has to sit at or above the current cursor."""
+        if int(id_start) < self._next_id:
+            raise ValueError(
+                f"id_start {id_start} would re-issue: this engine's next "
+                f"id is already {self._next_id}")
+        if int(id_stride) < 1:
+            raise ValueError(f"id_stride must be >= 1, got {id_stride}")
+        self._next_id = int(id_start)
+        self._id_stride = int(id_stride)
+
     def submit(
         self,
         prompt,

@@ -35,14 +35,25 @@ Three reconfiguration kinds ship behind one :class:`ReconfigSpec`:
   change, host swap records are discarded and every parked request
   resumes by re-prefill, so no stream ever decodes new weights against
   old K/V (the prefix cache is cleared for the same reason).
-- **replica scale** (:func:`replica_drain` / :func:`replica_activate`) —
-  drain one replica of a :class:`~gradaccum_tpu.serving.replicated.
-  ReplicatedEngine` through the same preempt/park path while its siblings
-  keep serving, re-dispatching the displaced work across the fleet;
-  activating brings a drained replica back into the candidate order. The
-  fleet is provisioned at construction — scaling moves replicas in and
-  out of ACTIVE service (the id lattice and routing stay intact), it does
-  not mint new engines.
+- **replica scale** (:func:`replica_drain` / :func:`replica_activate` /
+  :func:`replica_excise` / :func:`replica_add`) — drain one replica of a
+  :class:`~gradaccum_tpu.serving.replicated.ReplicatedEngine` through the
+  same preempt/park path while its siblings keep serving, re-dispatching
+  the displaced work across the fleet; activating brings a drained
+  replica back into the candidate order. EXCISE is the drain's
+  fleet-supervision twin for a replica that is DEAD (lease expired +
+  probe failed): the displaced work is rescued the same way, but the
+  member is decommissioned — routing never considers it again until an
+  operator activates it after repair. ADD mints a NEW engine at runtime
+  (``ReplicatedEngine.add_replica``), widening the request-id lattice to
+  the new modulus while in-flight ids keep their original owner (a
+  two-generation lattice map).
+
+Pool GROW is incremental: when the target ``num_blocks`` exceeds the
+current count, the engine appends a second block-pool segment
+(:meth:`PagedCachePool.grow`) addressed through the existing page table —
+zero preemptions, no quiesce, running slots untouched; only shrink (and
+same-size rebuild) pays the preempt→park→rebuild cycle below.
 
 The crash point ``resilience/faults.py::MID_RECONFIG`` fires twice per
 reconfiguration — index ``2n`` after the preempt (old config, everything
@@ -105,7 +116,8 @@ class ReconfigSpec:
     params: Any = None                   # checkpoint_swap: in-memory pytree
     draft_params: Any = None             # checkpoint_swap: optional new draft
     replica: Optional[int] = None        # replica_scale target
-    action: Optional[str] = None         # replica_scale: "drain"|"activate"
+    # replica_scale: "drain" | "activate" | "excise" | "add"
+    action: Optional[str] = None
     # who ordered this: "operator" (a human / external tooling) or
     # "healer" (the autonomous escalation ladder) — carried into the
     # result, the reconfig span event, and the /metrics counter labels
@@ -169,6 +181,27 @@ def replica_activate(replica: int,
     (its pool is empty — it rejoins cold, exactly like a fresh engine)."""
     return ReconfigSpec(REPLICA_SCALE, replica=int(replica),
                         action="activate", initiator=initiator)
+
+
+def replica_excise(replica: int,
+                   initiator: str = "operator") -> ReconfigSpec:
+    """Remove a DEAD replica from service without its cooperation — the
+    fleet-supervision path for a member whose liveness lease expired and
+    whose probe failed. Displaced queued/parked work is rescued onto the
+    survivors exactly like a drain, the member's dispatch slot is
+    decommissioned, and only an explicit ``replica_activate`` (after
+    repair) re-admits it."""
+    return ReconfigSpec(REPLICA_SCALE, replica=int(replica),
+                        action="excise", initiator=initiator)
+
+
+def replica_add(initiator: str = "operator") -> ReconfigSpec:
+    """Mint a NEW replica at runtime (``ReplicatedEngine.add_replica``):
+    the id lattice widens to the new modulus for freshly issued request
+    ids while every in-flight id keeps its original owner until
+    retirement, and the newcomer joins behind a warm-up admission ramp so
+    a cold pool cannot absorb a thundering herd."""
+    return ReconfigSpec(REPLICA_SCALE, action="add", initiator=initiator)
 
 
 @dataclasses.dataclass
@@ -301,6 +334,8 @@ def validate_pool_resize(engine, spec: ReconfigSpec) -> None:
 def _pool_resize(engine, spec: ReconfigSpec) -> ReconfigResult:
     validate_pool_resize(engine, spec)
     nb = int(spec.num_blocks)
+    if nb > engine.num_blocks:
+        return _pool_grow_incremental(engine, nb)
     _quiesce(engine)
     preempted = _preempt_all(engine)
     # crash point A: old config, everything parked — a kill here resumes
@@ -339,6 +374,37 @@ def _pool_resize(engine, spec: ReconfigSpec) -> ReconfigResult:
     return ReconfigResult(
         POOL_RESIZE, ok=True, preempted=preempted, tick=engine._tick,
         detail={"old_num_blocks": old_nb, "new_num_blocks": nb},
+    )
+
+
+def _pool_grow_incremental(engine, nb: int) -> ReconfigResult:
+    """GROW without touching anyone: append a second block-pool segment
+    (:meth:`PagedCachePool.grow`) instead of rebuilding. Running slots
+    keep their state, parked requests keep their swap records, the prefix
+    cache keeps every live entry (old block ids are still valid ids), and
+    zero preemptions are recorded — new work can admit against the widened
+    free list the moment this returns. The MID_RECONFIG crash points keep
+    their clean-old-or-clean-new contract: before the append nothing has
+    changed, after it the pool is already whole."""
+    # crash point A: old config, nothing mutated — a kill here is a no-op
+    faults.fire(faults.MID_RECONFIG, 2 * engine._reconfig_count)
+    old_nb = engine.num_blocks
+    engine.pool.grow(nb - old_nb)
+    engine.num_blocks = nb
+    if engine.mesh is not None:
+        # the appended segment's arrays land unsharded; re-commit the
+        # whole pool onto the mesh (placement-only, same as recover)
+        engine._apply_mesh()
+    # the remapped table through the SAME upload-time bounds check every
+    # tick uses — now against the TOTAL (both-segment) block count
+    engine.pool.page_table_device()
+    # crash point B: new config, segment appended and table republished
+    faults.fire(faults.MID_RECONFIG, 2 * engine._reconfig_count + 1)
+    return ReconfigResult(
+        POOL_RESIZE, ok=True, preempted=0, tick=engine._tick,
+        detail={"old_num_blocks": old_nb, "new_num_blocks": nb,
+                "incremental": True,
+                "segments": list(engine.pool.segments)},
     )
 
 

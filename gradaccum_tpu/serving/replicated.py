@@ -48,6 +48,7 @@ import numpy as np
 
 from gradaccum_tpu.models.gpt import GPTConfig
 from gradaccum_tpu.obs import trace as obs_trace
+from gradaccum_tpu.serving import fleet as fleet_lib
 from gradaccum_tpu.serving.engine import Engine, StepEvents
 from gradaccum_tpu.serving.metrics import ServingMetrics
 from gradaccum_tpu.serving.scheduler import QueueFull, Request, Scheduler
@@ -55,17 +56,19 @@ from gradaccum_tpu.serving.scheduler import QueueFull, Request, Scheduler
 
 class _FleetDict:
     """Routes rid-keyed dict access to the owning replica's dict
-    (``rid % N`` — the id-lattice invariant). Covers the operations the
+    (through the fleet's generation-aware ``_owner`` map — ``rid % N``
+    within the lattice generation that issued the rid, with hedged rids
+    following their adoptive replica). Covers the operations the
     server/driver/tests actually perform on ``engine.results`` /
     ``engine.status``."""
 
-    def __init__(self, engines: List[Engine], attr: str):
-        self._engines = engines
+    def __init__(self, fleet: "ReplicatedEngine", attr: str):
+        self._fleet = fleet
+        self._engines = fleet.replicas
         self._attr = attr
 
     def _d(self, rid: int) -> Dict:
-        return getattr(self._engines[int(rid) % len(self._engines)],
-                       self._attr)
+        return getattr(self._engines[self._fleet._owner(rid)], self._attr)
 
     def get(self, rid, default=None):
         return self._d(rid).get(rid, default)
@@ -132,10 +135,18 @@ class _FleetMetrics:
 
     def summary(self) -> dict:
         per = [e.metrics.summary() for e in self._fleet.replicas]
+        # excised members stay in the list, MARKED — dropping them would
+        # renumber every later replica's block and hide that the fleet
+        # shrank (their final counters are part of the fleet's history)
+        for i, p in enumerate(per):
+            p["excised"] = i in self._fleet._excised
+            p["membership"] = self._fleet.fleet.state(i)
         proposed = sum(p["spec_proposed"] for p in per)
         accepted = sum(p["spec_accepted"] for p in per)
         return {
             "replicas": len(per),
+            "excised_replicas": sorted(self._fleet._excised),
+            "active_replicas": self._fleet.active_replicas,
             "tokens_emitted": sum(p["tokens_emitted"] for p in per),
             "rejected": sum(p["rejected"] for p in per),
             "finished": _sum_dicts(p["finished"] for p in per),
@@ -203,6 +214,8 @@ class ReplicatedEngine:
         tracer=None,
         sentinel=None,
         latency_window: Optional[int] = None,
+        fleet_lease_ttl: float = 8.0,
+        fleet_suspect_after: Optional[float] = None,
         **engine_kwargs,
     ):
         if replicas < 1:
@@ -213,7 +226,6 @@ class ReplicatedEngine:
                 raise ValueError(f"{k!r} is managed per replica — pass "
                                  "ReplicatedEngine-level knobs instead")
         from gradaccum_tpu.obs.metrics import MetricsRegistry
-        from gradaccum_tpu.parallel.mesh import serving_mesh
 
         devices = list(jax.devices()) if devices is None else list(devices)
         self.cfg = cfg
@@ -228,20 +240,14 @@ class ReplicatedEngine:
         self.metrics = _FleetMetrics(self)
         self.replicas: List[Engine] = []
         self.tp = tp
+        # kept verbatim for live replica ADD: a member built later must be
+        # the same engine the fleet would have built at construction
+        self._devices = devices
+        self._engine_kwargs = dict(engine_kwargs)
+        self._scheduler_factory = scheduler_factory
+        self._latency_window = latency_window
         for i in range(replicas):
-            if tp is None:
-                mesh = None
-            elif replicas * tp <= len(devices):
-                mesh = serving_mesh(tp, devices=devices[i * tp:(i + 1) * tp])
-            elif tp == 1:
-                # more replicas than devices: share chips round-robin
-                # rather than refusing to run (CPU hosts, small dev boxes)
-                mesh = serving_mesh(1, devices=[devices[i % len(devices)]])
-            else:
-                raise ValueError(
-                    f"replicas={replicas} x tp={tp} needs "
-                    f"{replicas * tp} devices, have {len(devices)}"
-                )
+            mesh = self._mesh_for(i, replicas)
             sched = (scheduler_factory() if scheduler_factory is not None
                      else Scheduler())
             self.replicas.append(Engine(
@@ -251,21 +257,98 @@ class ReplicatedEngine:
                                        latency_window=latency_window),
                 tracer=tracer, **engine_kwargs,
             ))
-        self.results = _FleetDict(self.replicas, "results")
-        self.status = _FleetDict(self.replicas, "status")
+        self.results = _FleetDict(self, "results")
+        self.status = _FleetDict(self, "status")
         self._tick = 0
         self._faulted: Set[int] = set()
         # replicas taken out of service by a replica_scale reconfiguration
         # (drained: no dispatch, no ticks; the engine object and its slice
         # of the id lattice stay provisioned so activation is instant and
-        # rid % N routing never changes)
+        # in-generation rid % N routing never changes)
         self._inactive: Set[int] = set()
+        # terminal subset of _inactive: members removed by excision — never
+        # activatable, never evaluated, marked (not dropped) in stats
+        self._excised: Set[int] = set()
+        # id-lattice GENERATIONS, oldest first: (base_rid, modulus). A rid
+        # is owned by the newest generation whose base it reaches — so
+        # in-flight rids keep their original owner across add_replica while
+        # new rids route through the widened modulus
+        self._generations: List[tuple] = [(0, replicas)]
+        # hedged rids: requests moved (same rid) off a SUSPECT member to an
+        # adoptive sibling; consulted by _owner ahead of the generations
+        self._moved: Dict[int, int] = {}
+        # warm-up admission ramp for freshly-added replicas: replica ->
+        # admissions taken so far; concurrent load is capped at 2**count
+        # until the cap clears num_slots, so a cold member can't absorb a
+        # thundering herd on its first tick. The ramp also ages out after
+        # a fixed number of supervision intervals (_warmup_age) — an
+        # unsaturated fleet would otherwise never route the newcomer
+        # enough admissions to graduate it
+        self._warmup: Dict[int, int] = {}
+        # membership registry: leases measured on the fleet tick clock
+        # (max replica tick — advances while ANY member makes progress, so
+        # an idle fleet never false-expires), probed out-of-band via tick
+        # progress (a partitioned member keeps ticking; a dead one freezes)
+        self._warmup_age: Dict[int, int] = {}
+        self._probe_seen: Dict[int, int] = {}
+        self.fleet = fleet_lib.FleetSupervisor(
+            replicas, lease_ttl=fleet_lease_ttl,
+            suspect_after=fleet_suspect_after,
+            probe=self._probe_replica, clock=self._fleet_clock)
         # healthy replicas' events from a partially-faulted tick, delivered
         # with the next clean tick (see step())
         self._held: List[StepEvents] = []
         self._pool = (ThreadPoolExecutor(
             max_workers=replicas, thread_name_prefix="serving-replica")
             if replicas > 1 else None)
+
+    def _mesh_for(self, i: int, total: int):
+        """Device carving for replica ``i`` of ``total`` (same rules at
+        construction and at live ADD)."""
+        from gradaccum_tpu.parallel.mesh import serving_mesh
+
+        tp, devices = self.tp, self._devices
+        if tp is None:
+            return None
+        if total * tp <= len(devices):
+            return serving_mesh(tp, devices=devices[i * tp:(i + 1) * tp])
+        if tp == 1:
+            # more replicas than devices: share chips round-robin rather
+            # than refusing to run (CPU hosts, small dev boxes)
+            return serving_mesh(1, devices=[devices[i % len(devices)]])
+        raise ValueError(
+            f"replicas={total} x tp={tp} needs "
+            f"{total * tp} devices, have {len(devices)}"
+        )
+
+    def _fleet_clock(self) -> float:
+        """Lease clock = the fleet's furthest tick. Advances while any
+        member makes progress; freezes when the whole fleet is idle (an
+        idle fleet must never expire into false SUSPECTs)."""
+        return float(max(e.tick_count for e in self.replicas))
+
+    def _probe_replica(self, replica: int) -> bool:
+        """Out-of-band liveness probe: has the member's OWN tick advanced
+        since the last probe? Bypasses the heartbeat path on purpose — a
+        ``lease_partition`` drops renewals while the member keeps
+        ticking, and this is what keeps it SUSPECT instead of DEAD."""
+        cur = self.replicas[replica].tick_count
+        seen = self._probe_seen.get(replica)
+        self._probe_seen[replica] = cur
+        return seen is None or cur > seen
+
+    def _owner(self, rid: int) -> int:
+        """Owning replica index for a request id: hedged rids follow
+        their adoptive replica; everything else routes within the newest
+        id-lattice generation whose base the rid reaches."""
+        rid = int(rid)
+        home = self._moved.get(rid)
+        if home is not None:
+            return home
+        for base, mod in reversed(self._generations):
+            if rid >= base:
+                return rid % mod
+        return rid % self._generations[0][1]
 
     # -- introspection ----------------------------------------------------
 
@@ -342,8 +425,15 @@ class ReplicatedEngine:
         """ACTIVE replica indices in dispatch order: longest live prefix
         match first (affinity — the blocks are THERE, a different replica
         would cold-miss), then least loaded, then lowest index
-        (determinism). Drained replicas are out of the order entirely."""
-        keys = []
+        (determinism). Drained/excised replicas are out of the order
+        entirely; SUSPECT members (stale lease) take no NEW admissions
+        unless the whole fleet is suspect (degraded routing beats
+        refusing service on what may be a supervision false positive);
+        warming members (fresh ADD) sort last under their admission-ramp
+        load cap, and when NOTHING else is routable the cap yields —
+        a fleet rebuilt entirely from fresh ADDs takes backpressure
+        (``QueueFull``) rather than a false "drained" refusal."""
+        keys, ramp, capped, suspects = [], [], [], []
         for i, e in enumerate(self.replicas):
             if i in self._inactive:
                 continue
@@ -351,13 +441,31 @@ class ReplicatedEngine:
             if e.prefix_cache is not None and prompt.size > e.page_size:
                 shared = len(e.prefix_cache.match(prompt))
             load = e.scheduler.depth + e.pool.active_count
+            if not self.fleet.routable(i):
+                suspects.append((load, i))
+                continue
+            if i in self._warmup:
+                (ramp if load < (1 << self._warmup[i])
+                 else capped).append((load, i))
+                continue
             keys.append((-shared, load, i))
-        if not keys:
+        order = [i for _, _, i in sorted(keys)] + \
+                [i for _, i in sorted(ramp)]
+        if not order:
+            order = [i for _, i in sorted(suspects)]
+        if not order:
+            # every routable member is a warming replica at its ramp
+            # cap: the cap exists to spread a thundering herd across
+            # SEASONED siblings, and there are none — route anyway and
+            # let Engine.submit apply real backpressure, because the
+            # capacity exists as soon as the ramp advances or ages out
+            order = [i for _, i in sorted(capped)]
+        if not order:
             raise RuntimeError(
                 "every replica is drained — activate one "
                 "(reconfig.replica_activate) before submitting"
             )
-        return [i for _, _, i in sorted(keys)]
+        return order
 
     def submit(self, prompt, max_new_tokens: int,
                eos_id: Optional[int] = None, rng_seed: int = 0,
@@ -371,20 +479,45 @@ class ReplicatedEngine:
         order = self._candidates(arr)
         for idx in order:
             try:
-                return self.replicas[idx].submit(
+                rid = self.replicas[idx].submit(
                     prompt, max_new_tokens, eos_id=eos_id, rng_seed=rng_seed,
                     deadline_ticks=deadline_ticks, _quiet_full=True,
                 )
             except QueueFull:
                 continue
+            self._note_warmup_admit(idx)
+            return rid
         # every replica refused: resubmit to the best candidate WITHOUT
         # the quiet flag so exactly ONE client-visible rejection lands in
         # telemetry — the probe attempts above record none, keeping
         # rejected_total an honest count of requests clients lost
-        return self.replicas[order[0]].submit(
-            prompt, max_new_tokens, eos_id=eos_id, rng_seed=rng_seed,
-            deadline_ticks=deadline_ticks,
-        )
+        try:
+            rid = self.replicas[order[0]].submit(
+                prompt, max_new_tokens, eos_id=eos_id, rng_seed=rng_seed,
+                deadline_ticks=deadline_ticks,
+            )
+        except QueueFull as exc:
+            if self._excised:
+                # a shrunken fleet must say so: the stale pre-excision
+                # replica count would send operators hunting a member
+                # that no longer exists
+                gone = ", ".join(f"replica {i} excised"
+                                 for i in sorted(self._excised))
+                raise QueueFull(
+                    f"{exc} ({gone}; {len(self.active_replicas)} active)"
+                ) from None
+            raise
+        self._note_warmup_admit(order[0])
+        return rid
+
+    def _note_warmup_admit(self, idx: int) -> None:
+        """Advance a warming replica's admission ramp (cap doubles per
+        admission; the ramp retires once it clears the slot count)."""
+        if idx in self._warmup:
+            self._warmup[idx] += 1
+            if (1 << self._warmup[idx]) >= self.replicas[idx].pool.num_slots:
+                del self._warmup[idx]
+                self._warmup_age.pop(idx, None)
 
     # -- the tick ----------------------------------------------------------
 
@@ -398,13 +531,15 @@ class ReplicatedEngine:
         snt = self.sentinel
         # drained replicas sit ticks out entirely: no work can reach them
         # and a parked lease on an intentionally idle engine must not
-        # masquerade as a heartbeat
+        # masquerade as a heartbeat; halted members (injected kill/wedge)
+        # sit out because the fault IS the missing tick
         active = [i for i in range(len(self.replicas))
-                  if i not in self._inactive]
+                  if i not in self._inactive and not self.fleet.halted(i)]
         if self._pool is None:
             evs = []
             for i in active:
                 evs.append(self.replicas[i].step())
+                self.fleet.heartbeat(i)
                 if snt is not None:
                     snt.heartbeat(replica=i,
                                   tick=self.replicas[i].tick_count,
@@ -424,6 +559,7 @@ class ReplicatedEngine:
             for i, w in waits:
                 try:
                     evs.append(w())
+                    self.fleet.heartbeat(i)
                     if snt is not None:
                         # only a CLEAN replica tick renews the lease — a
                         # replica stuck faulting goes quiet and expires
@@ -454,17 +590,126 @@ class ReplicatedEngine:
             admitted.extend(ev.admitted)
         self._held = []
         self._tick = t + 1
+        self.supervise()
         return StepEvents(emitted, finished, admitted, t)
+
+    # -- fleet supervision --------------------------------------------------
+
+    def supervise(self) -> List["fleet_lib.Transition"]:
+        """One supervision interval: renew intentionally-idle (drained)
+        members' leases, poll the membership registry, and hedge a
+        newly-SUSPECT member's WAITING work to siblings. Lockstep
+        ``step()`` calls this every tick; the free-running server calls
+        it from its maintenance cadence."""
+        for i in self._inactive:
+            self.fleet.heartbeat(i)
+        for i in list(self._warmup):
+            self._warmup_age[i] = self._warmup_age.get(i, 0) + 1
+            if self._warmup_age[i] >= 16:
+                del self._warmup[i]
+                self._warmup_age.pop(i, None)
+        moved = self.fleet.poll()
+        tr = self.tracer
+        for t in moved:
+            if tr.enabled:
+                tr.event("fleet/transition", cat="serving",
+                         replica=t.replica, old=t.old, new=t.new,
+                         reason=t.reason, **self.obs_tags())
+            if t.new == fleet_lib.SUSPECT:
+                self._hedge_replica(t.replica)
+            elif t.new == fleet_lib.DEAD:
+                snt = getattr(self, "sentinel", None)
+                if snt is not None:
+                    # the registry's own verdict reaches the healer even
+                    # when the member died IDLE (its heartbeat lease was
+                    # parked, so the lease detector stays silent); fire()
+                    # dedups against an already-firing lease anomaly
+                    snt.fire("dead_replica", replica=t.replica,
+                             detail={"source": "fleet_lease",
+                                     "reason": t.reason})
+        return moved
+
+    def _hedge_replica(self, replica: int) -> int:
+        """Move a SUSPECT member's WAITING work — parked first, then the
+        fresh queue — to siblings, keeping each request's rid (the
+        ``_moved`` remap reroutes results/status/cancel to the adoptive
+        replica, so front-end handles survive untouched). Running slots
+        stay put: the member may well recover and finish them, and if it
+        is later declared DEAD the excision path rescues them. A parked
+        request's replica-local resume state (swap record, parked K/V)
+        cannot migrate, so it replays from scratch on its new home —
+        the fault-requeue contract (greedy replay token-identical).
+        Siblings with no queue room decline; the request then stays with
+        its suspect owner rather than being dropped."""
+        replica = self._check_replica(replica)
+        e = self.replicas[replica]
+        hedged = 0
+        waiting: List[Request] = []
+        while e.scheduler.parked_depth:
+            req = e.scheduler.pop_parked()
+            rid = req.request_id
+            e._parked_state.pop(rid, None)
+            if e._swap_store is not None:
+                e._swap_store.discard(rid)
+            waiting.append(req)
+        waiting.extend(e.scheduler.drain_queue())
+        for req in waiting:
+            rid = req.request_id
+            dst = None
+            try:
+                order = self._candidates(req.prompt)
+            except RuntimeError:
+                order = []  # nothing routable anywhere: keep ownership
+            for j in order:
+                if j == replica:
+                    continue
+                sib = self.replicas[j]
+                try:
+                    sib.scheduler.submit(self._rebase_deadline(req, e, sib))
+                except QueueFull:
+                    continue
+                dst = j
+                break
+            if dst is None:
+                # no sibling capacity: the suspect member keeps it
+                e.scheduler.submit(req)
+                continue
+            self._moved[rid] = dst
+            # the result stream restarts on the adoptive replica (replay
+            # from scratch); stale partial output must not prefix it
+            e.results.pop(rid, None)
+            e.status.pop(rid, None)
+            self.replicas[dst].results[rid] = []
+            self.replicas[dst].status[rid] = "queued"
+            hedged += 1
+        if hedged and self.tracer.enabled:
+            self.tracer.event("fleet/hedge", cat="serving", replica=replica,
+                              hedged=hedged, **self.obs_tags())
+        return hedged
+
+    @staticmethod
+    def _rebase_deadline(req: Request, src: Engine, dst: Engine) -> Request:
+        """Re-express a request's deadline in the adoptive replica's tick
+        frame (each engine counts its own ticks)."""
+        import dataclasses as _dc
+
+        if req.deadline_tick is None:
+            return req
+        remaining = max(0, req.deadline_tick - src.tick_count)
+        return _dc.replace(req, deadline_tick=dst.tick_count + remaining,
+                           submit_tick=dst.tick_count)
 
     # -- lifecycle ----------------------------------------------------------
 
     def pop_result(self, request_id: int):
-        return self.replicas[request_id % len(self.replicas)] \
-            .pop_result(request_id)
+        out = self.replicas[self._owner(request_id)].pop_result(request_id)
+        self._moved.pop(int(request_id), None)
+        return out
 
     def cancel(self, request_id: int) -> bool:
-        return self.replicas[request_id % len(self.replicas)] \
-            .cancel(request_id)
+        out = self.replicas[self._owner(request_id)].cancel(request_id)
+        self._moved.pop(int(request_id), None)
+        return out
 
     def recover(self) -> List[Request]:
         """Reset ONLY the replicas whose last ``step()`` raised (all of
@@ -535,6 +780,10 @@ class ReplicatedEngine:
             e.results.pop(req.request_id, None)
             e.status.pop(req.request_id, None)
             displaced.append(req)
+        # requests previously hedged ONTO this replica just got displaced
+        # with the rest — their remap entries must not keep routing their
+        # (about to be reissued) rids here
+        self._moved = {r: d for r, d in self._moved.items() if d != replica}
         if self.sentinel is not None:
             # the drained replica stops ticking ON PURPOSE: park its
             # heartbeat lease, or the planned silence fires a false
@@ -546,8 +795,100 @@ class ReplicatedEngine:
 
     def activate_replica(self, replica: int) -> None:
         """Return a drained replica to the dispatch candidate order (it
-        rejoins with an empty pool, like a fresh engine)."""
-        self._inactive.discard(self._check_replica(replica))
+        rejoins with an empty pool, like a fresh engine). Excision is
+        terminal — an excised member cannot be reactivated; provision
+        new capacity with :meth:`add_replica` instead."""
+        replica = self._check_replica(replica)
+        if replica in self._excised:
+            raise ValueError(
+                f"replica {replica} is excised — excision is terminal; "
+                "add_replica() provisions replacement capacity")
+        self._inactive.discard(replica)
+
+    def add_replica(self) -> int:
+        """Provision one NEW replica into the live fleet (the capacity
+        half of excise-and-replace; also plain horizontal scale-out).
+
+        The id lattice WIDENS by one generation: a fresh base rid above
+        everything issued so far opens a ``rid % (N+1)`` modulus that
+        only new submissions reach — every in-flight rid stays below the
+        base and keeps routing to its original owner through the old
+        modulus until it retires. Existing engines are rebased onto the
+        widened lattice (their next issue lands in the new generation),
+        the new engine is built exactly as construction would have built
+        it (same params/knobs, its own mesh carve, its own metrics
+        labels), and it joins dispatch behind a warm-up admission ramp.
+        NOT thread-safe; a ServingServer runs this under maintenance()
+        via ``request_reconfig(reconfig.replica_add())``."""
+        idx = len(self.replicas)
+        total = idx + 1
+        mesh = self._mesh_for(idx, total)
+        sched = (self._scheduler_factory()
+                 if self._scheduler_factory is not None else Scheduler())
+        base = max(e._next_id for e in self.replicas)
+        # smallest rid >= base owned by each lattice position under the
+        # widened modulus; rebase BEFORE the new engine exists so no old
+        # engine can issue below the new generation's base
+        for j, e in enumerate(self.replicas):
+            e.rebase_ids(base + ((j - base) % total), total)
+        eng = Engine(
+            self.replicas[0].params, self.cfg, mesh=mesh, replica_id=idx,
+            id_start=base + ((idx - base) % total), id_stride=total,
+            scheduler=sched,
+            metrics=ServingMetrics(registry=self.registry, replica_id=idx,
+                                   latency_window=self._latency_window),
+            tracer=self._tracer, **self._engine_kwargs,
+        )
+        self.replicas.append(eng)
+        self._generations.append((base, total))
+        self._warmup[idx] = 0
+        self.fleet.add_member(idx)
+        # lockstep step() fans ticks across a pool sized at construction —
+        # rebuild it one wider (free-running server loops don't use it)
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+        self._pool = ThreadPoolExecutor(
+            max_workers=total, thread_name_prefix="serving-replica")
+        if self.tracer.enabled:
+            self.tracer.event("fleet/add_replica", cat="serving",
+                              replica=idx, generations=len(self._generations),
+                              **self.obs_tags())
+        return idx
+
+    def excise_replica(self, replica: int):
+        """Remove a DEAD member: prove its departure with one
+        partial-consensus round the member cannot vote in, then drain
+        its displaced work to siblings and decommission its dispatch
+        slot. Returns ``(displaced, proof)``. Refuses (raises
+        RuntimeError) unless the membership registry has the member at
+        DEAD — a SUSPECT member may only be drained, and a partitioned
+        member's live probe keeps it SUSPECT precisely so this refusal
+        protects it."""
+        replica = self._check_replica(replica)
+        if replica in self._excised:
+            raise RuntimeError(f"replica {replica} is already excised")
+        state = self.fleet.state(replica)
+        if state != fleet_lib.DEAD:
+            raise RuntimeError(
+                f"excision refused: replica {replica} is {state!r}, not "
+                f"{fleet_lib.DEAD!r} — only a member whose lease expired "
+                "AND whose probe failed may be excised")
+        proof = self.fleet.excise_proof(replica, step=self._tick)
+        if not proof.valid:
+            raise RuntimeError(
+                f"excision refused: consensus round resolved WITH replica "
+                f"{replica} present (absent={proof.absent}) — it is not "
+                "provably gone")
+        displaced = self.drain_replica(replica)
+        self._excised.add(replica)
+        self._warmup.pop(replica, None)
+        self._warmup_age.pop(replica, None)
+        self.fleet.decommission(replica)
+        if self.tracer.enabled:
+            self.tracer.event("fleet/excise", cat="serving", replica=replica,
+                              displaced=len(displaced),
+                              voters=list(proof.voters), **self.obs_tags())
+        return displaced, proof
 
     def reconfigure(self, spec, resubmit: bool = True):
         """Fleet-wide live reconfiguration. ``pool_resize`` and
@@ -573,19 +914,65 @@ class ReplicatedEngine:
 
         tr = self.tracer
         if spec.kind == reconfig_lib.REPLICA_SCALE:
-            replica = self._check_replica(spec.replica)
-            e = self.replicas[replica]
-            if spec.action == "activate":
-                self.activate_replica(replica)
+            if spec.action == "add":
+                idx = self.add_replica()
                 result = reconfig_lib.ReconfigResult(
                     spec.kind, ok=True, tick=self._tick,
                     initiator=spec.initiator,
-                    detail={"replica": replica, "action": "activate",
-                            "active_replicas": self.active_replicas},
+                    detail={"replica": idx, "action": "add",
+                            "active_replicas": self.active_replicas,
+                            "generations": [list(g)
+                                            for g in self._generations],
+                            "warmup": True},
                 )
+                e = self.replicas[idx]
+                replica = idx
             else:
+                replica = self._check_replica(spec.replica)
+                e = self.replicas[replica]
+            if spec.action == "activate":
+                try:
+                    self.activate_replica(replica)
+                except ValueError as exc:
+                    # excision is terminal: structured refusal, no mutation
+                    result = reconfig_lib.ReconfigResult(
+                        spec.kind, ok=False, reason=str(exc),
+                        tick=self._tick, initiator=spec.initiator,
+                        detail={"replica": replica, "action": "activate"},
+                    )
+                else:
+                    result = reconfig_lib.ReconfigResult(
+                        spec.kind, ok=True, tick=self._tick,
+                        initiator=spec.initiator,
+                        detail={"replica": replica, "action": "activate",
+                                "active_replicas": self.active_replicas},
+                    )
+            elif spec.action in ("drain", "excise"):
                 src_tick = e.tick_count
-                displaced = self.drain_replica(replica)
+                proof = None
+                if spec.action == "excise":
+                    try:
+                        displaced, proof = self.excise_replica(replica)
+                    except RuntimeError as exc:
+                        # refusal (member not provably dead): structured,
+                        # nothing mutated — the healer ladder escalates
+                        result = reconfig_lib.ReconfigResult(
+                            spec.kind, ok=False, reason=str(exc),
+                            tick=self._tick, initiator=spec.initiator,
+                            detail={"replica": replica, "action": "excise"},
+                        )
+                        e.metrics.record_reconfig(
+                            spec.kind, ok=False, preempted=0,
+                            initiator=spec.initiator)
+                        if tr.enabled:
+                            tr.event("serve/reconfig", cat="serving",
+                                     kind=spec.kind, ok=False,
+                                     replica=replica, action=spec.action,
+                                     initiator=spec.initiator,
+                                     **self.obs_tags())
+                        return result
+                else:
+                    displaced = self.drain_replica(replica)
                 moved: Dict[int, int] = {}
                 failed: List[int] = []
                 if resubmit:
@@ -608,9 +995,14 @@ class ReplicatedEngine:
                                  "found no sibling capacity"),
                     preempted=len(displaced), tick=self._tick,
                     initiator=spec.initiator,
-                    detail={"replica": replica, "action": "drain",
+                    detail={"replica": replica, "action": spec.action,
                             "active_replicas": self.active_replicas,
                             "resubmitted": moved, "failed": failed,
+                            **({} if proof is None else {"excise_proof": {
+                                "voters": list(proof.voters),
+                                "absent": list(proof.absent),
+                                "decision": list(proof.decision),
+                                "valid": proof.valid}}),
                             **({} if resubmit
                                else {"displaced": displaced})},
                 )

@@ -543,7 +543,15 @@ class ServingServer:
             try:
                 with self._maintenance():
                     if (fleet and spec.kind == reconfig_lib.REPLICA_SCALE
-                            and spec.action == "drain"):
+                            and spec.action in ("drain", "excise")):
+                        # drain AND excise displace work that must be
+                        # re-dispatched with its stream handles REBOUND —
+                        # the engine cannot do that (handles live here),
+                        # so both route through resubmit=False plus
+                        # _requeue_displaced. Excise may be REFUSED by
+                        # the membership gate (not DEAD / invalid proof):
+                        # that surfaces as ok=False with no displaced
+                        # work, and the requeue below is a no-op.
                         replica = eng._check_replica(spec.replica)
                         with self._engine_locked():
                             src_tick = eng.replicas[replica].tick_count
@@ -558,6 +566,10 @@ class ServingServer:
                             result.reason = (f"{len(failed)} displaced "
                                              "request(s) found no sibling "
                                              "capacity")
+                    elif (fleet and spec.kind == reconfig_lib.REPLICA_SCALE
+                            and spec.action == "add"
+                            and self._free_running):
+                        result = self._execute_replica_add(spec)
                     else:
                         with self._engine_locked():
                             result = eng.reconfigure(spec)
@@ -642,6 +654,40 @@ class ServingServer:
                     if rid in self._handles:
                         self._requeues[rid] = n
         return moved, failed
+
+    def _execute_replica_add(self, spec):
+        """Free-running live ADD: widen the fleet AND provision the
+        server-side seat the new member needs — its engine lock, fault
+        budget, watchdog window, and a fresh ``serving-replica-{idx}``
+        loop thread. The lock and budget are appended BEFORE the engine
+        widens (``_dispatch_free`` indexes ``_rlocks`` by candidate id,
+        so the seat must exist by the instant ``_candidates`` can name
+        the newcomer) and rolled back if the engine refuses."""
+        self._rlocks.append(threading.Lock())
+        self._rfaults.append(0)
+        try:
+            with self._engine_locked():
+                result = self._engine.reconfigure(spec)
+        except BaseException:
+            self._rlocks.pop()
+            self._rfaults.pop()
+            raise
+        if not result.ok:
+            self._rlocks.pop()
+            self._rfaults.pop()
+            return result
+        idx = result.detail["replica"]
+        if self._watchdogs is not None:
+            wd = Watchdog(self._watchdog_timeout, self._on_stall,
+                          tracer=self._engine._tracer)
+            self._engine.replicas[idx].watchdog = wd
+            self._watchdogs.append(wd)
+            wd.start()
+        th = threading.Thread(target=self._replica_loop, args=(idx,),
+                              daemon=True, name=f"serving-replica-{idx}")
+        self._threads.append(th)
+        th.start()
+        return result
 
     def stop(self) -> None:
         """Stop the loop and close the engine. Re-raises (wrapped) any
@@ -814,6 +860,7 @@ class ServingServer:
             for i, e in enumerate(self._engine.replicas):
                 with self._rlocks[i]:
                     per.append(self._engine_stats(e))
+            self._mark_membership(per)
             out = {
                 "replicas": len(per),
                 "tick": max(p["tick"] for p in per),
@@ -826,6 +873,9 @@ class ServingServer:
             if self._engine.paged:
                 out["free_kv_blocks"] = sum(p["free_kv_blocks"] for p in per)
                 out["num_kv_blocks"] = sum(p["num_kv_blocks"] for p in per)
+            if getattr(self._engine, "fleet", None) is not None:
+                out["fleet"] = self._engine.fleet.status()
+                out["excised_replicas"] = sorted(self._engine._excised)
             if self._healer is not None:
                 out["healer"] = self._healer.status()
             return out
@@ -838,6 +888,7 @@ class ServingServer:
                     out["healer"] = self._healer.status()
                 return out
             per = [self._engine_stats(e) for e in replicas]
+            self._mark_membership(per)
             out = {
                 "replicas": len(replicas),
                 "tick": engine.tick_count,
@@ -849,9 +900,24 @@ class ServingServer:
             if engine.paged:
                 out["free_kv_blocks"] = sum(p["free_kv_blocks"] for p in per)
                 out["num_kv_blocks"] = sum(p["num_kv_blocks"] for p in per)
+            if getattr(engine, "fleet", None) is not None:
+                out["fleet"] = engine.fleet.status()
+                out["excised_replicas"] = sorted(engine._excised)
             if self._healer is not None:
                 out["healer"] = self._healer.status()
         return out
+
+    def _mark_membership(self, per: List[Dict]) -> None:
+        """Stamp each per-replica stats block with its fleet membership
+        state; an excised member must read as excised in every operator
+        surface, not as a mysteriously idle replica."""
+        fleet = getattr(self._engine, "fleet", None)
+        if fleet is None:
+            return
+        for i, p in enumerate(per):
+            p["membership"] = fleet.state(i)
+            if i in self._engine._excised:
+                p["excised"] = True
 
     def cancel(self, request_id: int) -> bool:
         """Thread-safe cancel of a queued or RUNNING request (the engine's
@@ -860,7 +926,9 @@ class ServingServer:
         owning replica's lock). The request's handle finishes with reason
         "cancelled", keeping any tokens already streamed. False for
         unknown / already-finished ids."""
-        lock = (self._rlocks[request_id % len(self._engine.replicas)]
+        # the fleet's _owner maps rid -> replica through the generation
+        # lattice (plain modulo breaks once add_replica widens the fleet)
+        lock = (self._rlocks[self._engine._owner(request_id)]
                 if self._free_running else self._lock)
         with lock:
             ok = self._engine.cancel(request_id)
@@ -926,10 +994,25 @@ class ServingServer:
                 try:
                     rid = fleet.replicas[idx].submit(
                         prompt, max_new_tokens, _quiet_full=quiet, **kwargs)
-                except QueueFull:
+                except QueueFull as exc:
                     if quiet:
                         continue
+                    excised = getattr(fleet, "_excised", None)
+                    if excised:
+                        # backpressure on a shrunken fleet must say so:
+                        # "queue full" reads very differently when a
+                        # member was excised out from under the capacity
+                        gone = ", ".join(f"replica {r} excised"
+                                         for r in sorted(excised))
+                        raise QueueFull(
+                            f"{exc} ({gone}; "
+                            f"{len(fleet.active_replicas)} active)"
+                        ) from None
                     raise
+                if hasattr(fleet, "_note_warmup_admit"):
+                    # free-running submits bypass ReplicatedEngine.submit,
+                    # so the warm-up ramp is advanced here instead
+                    fleet._note_warmup_admit(idx)
                 h = handle
                 if register:
                     h = handle if handle is not None else StreamHandle(rid)
@@ -1016,7 +1099,7 @@ class ServingServer:
         with self._hlock:
             known = [rid for rid in self._handles
                      if replica is None
-                     or rid % len(self._engine.replicas) == replica]
+                     or self._engine._owner(rid) == replica]
         retired = []
         with elock:
             failed = eng.recover()
@@ -1254,6 +1337,26 @@ class ServingServer:
             self._fail_handles(e)
             raise
 
+    def _fleet_steward(self) -> int:
+        """The replica whose loop runs fleet-singleton duties this pass
+        (the supervision cadence, SLO evaluator ticks): the LOWEST live
+        member — not halted by an injected kill/wedge, not excised.
+        Re-resolved every loop pass so the duties fail over the moment
+        the current steward dies; hard-coding replica 0 left the
+        membership registry unpolled (no SUSPECT/DEAD staging, no
+        excision, admissions dispatched to a corpse forever) exactly
+        when replica 0 was the victim."""
+        eng = self._engine
+        fleet_sup = getattr(eng, "fleet", None)
+        excised = getattr(eng, "_excised", ())
+        for j in range(len(eng.replicas)):
+            if j in excised:
+                continue
+            if fleet_sup is not None and fleet_sup.halted(j):
+                continue
+            return j
+        return 0  # a fleet of corpses: nothing left to steward
+
     def _replica_loop(self, i: int) -> None:
         """One free-running replica's serving loop: tick MY engine under
         MY lock at my own pace — no fleet barrier, so this replica's
@@ -1262,14 +1365,26 @@ class ServingServer:
         window, per-replica sentinel heartbeat/latency/accept feeds,
         per-replica fault budget (a give-up still poisons the whole
         server — the budgets bound faults, not the blast radius of giving
-        up). The SLO evaluator ticks from replica 0's loop (it reads the
-        one shared fleet registry; N tickers would just multiply pulls)."""
+        up). The SLO evaluator ticks from the steward replica's loop (it
+        reads the one shared fleet registry; N tickers would just
+        multiply pulls)."""
         eng = self._engine.replicas[i]
         lock = self._rlocks[i]
         wd = self._watchdogs[i] if self._watchdogs is not None else None
         snt = self._sentinel
+        fleet_sup = getattr(self._engine, "fleet", None)
+        sup_n = 0  # this loop's supervision cadence (used while steward)
         try:
             while not self._stop.is_set():
+                if fleet_sup is not None and (fleet_sup.halted(i)
+                                              or i in self._engine._excised):
+                    # a killed/wedged member stops ticking (its silence is
+                    # exactly what the membership leases detect); excision
+                    # is terminal, so that loop retires for good
+                    if i in self._engine._excised:
+                        return
+                    self._stop.wait(self._idle_sleep)
+                    continue
                 with self._hlock:
                     if self._error is not None:
                         return  # stall/give-up already failed the handles
@@ -1312,17 +1427,37 @@ class ServingServer:
                     self._handle_engine_fault(e, replica=i)
                     continue
                 if events is None:
+                    if fleet_sup is not None:
+                        # an idle member is alive — renew its lease. The
+                        # fleet clock is max(tick) across replicas, so a
+                        # neighbor decoding one long stream keeps the
+                        # clock advancing while this loop has nothing to
+                        # do; without the renewal a perfectly healthy
+                        # idle replica ages past suspect/ttl (and its
+                        # probe fails too: an idle tick never advances)
+                        # and gets falsely staged SUSPECT, then excised
+                        fleet_sup.heartbeat(i)
                     if snt is not None:
                         snt.heartbeat(replica=i, tick=eng.tick_count,
                                       busy=False)
                         snt.check()
+                    if fleet_sup is not None and i == self._fleet_steward():
+                        # a killed member must be aged out even while the
+                        # steward has nothing to decode; stewardship is
+                        # re-resolved per pass so supervision survives
+                        # any single member's death
+                        sup_n += 1
+                        if sup_n >= 4:
+                            sup_n = 0
+                            with self._engine_locked():
+                                self._engine.supervise()
                     if self._healer is not None:
                         # every replica loop advances the ladder clock;
                         # the healer locks internally and its actions are
                         # per-target (a rung aimed at replica j is claimed
                         # by j's loop)
                         self._healer.poll()
-                    if self._slo is not None and i == 0:
+                    if self._slo is not None and i == self._fleet_steward():
                         # MY replica being idle says nothing about the
                         # fleet: the evaluator pulls the SHARED registry,
                         # so its windows must advance (fire AND resolve)
@@ -1331,6 +1466,19 @@ class ServingServer:
                     self._stop.wait(self._idle_sleep)
                     continue
                 self._rfaults[i] = 0  # a clean tick resets this budget
+                if fleet_sup is not None:
+                    # a clean tick renews MY membership lease; the steward
+                    # loop additionally ages the whole fleet's leases
+                    # (supervise hedges a SUSPECT member's parked/queued
+                    # work across siblings, which touches other replicas'
+                    # schedulers — hence every lock, in order)
+                    fleet_sup.heartbeat(i)
+                    if i == self._fleet_steward():
+                        sup_n += 1
+                        if sup_n >= 4:
+                            sup_n = 0
+                            with self._engine_locked():
+                                self._engine.supervise()
                 if snt is not None:
                     snt.heartbeat(replica=i, tick=eng.tick_count,
                                   busy=not eng.idle)
@@ -1347,7 +1495,7 @@ class ServingServer:
                     snt.check()
                 if self._healer is not None:
                     self._healer.poll()
-                if self._slo is not None and i == 0:
+                if self._slo is not None and i == self._fleet_steward():
                     self._slo.tick()
                 for rid, tok in events.emitted:
                     with self._hlock:

@@ -429,7 +429,7 @@ def test_default_ladders_shape():
     assert [r.name for r in ladders[obs_sentinel.PREEMPTION_STORM]] == \
         ["governor_pin", "pool_grow"]
     assert [r.name for r in ladders[obs_sentinel.DEAD_REPLICA]] == \
-        ["recover_requeue", "replica_drain"]
+        ["recover_requeue", "replica_excise", "replica_add"]
     assert [r.name for r in ladders[obs_sentinel.SCALE_STORM]] == \
         ["checkpoint_rollback"]
     h = Healer(snt, ladders)

@@ -99,7 +99,11 @@ def test_pool_grow_parity_under_traffic(cfg, params):
         eng.step()
     res = eng.reconfigure(pool_resize(24))
     assert res.ok and res.kind == "pool_resize"
-    assert res.preempted > 0  # requests were genuinely in flight
+    # growing is now incremental: the new blocks are appended as a
+    # fresh segment and nothing in flight is touched
+    assert res.preempted == 0
+    assert res.detail.get("incremental") is True
+    assert res.detail.get("segments") == [16, 8]
     assert eng.num_blocks == 24 and eng.pool.num_blocks == 24
     _drain_and_check(eng, params, cfg, reqs)
     assert eng.metrics.reconfigs == {"pool_resize": 1}
@@ -199,9 +203,10 @@ def test_resize_refused_on_fixed_pool(cfg, params):
 
 def test_reconfiguring_stall_label(cfg, params):
     """Fresh traffic held by the quiesce is named, like PR-12's
-    held_by_quantile_gate."""
+    held_by_quantile_gate. Shrink is the reconfig that still quiesces —
+    grow went incremental (zero-preemption) and never stalls anyone."""
     eng = Engine(params, cfg, num_slots=1, max_len=32, page_size=4,
-                 num_blocks=8)
+                 num_blocks=16)
     prompts = _prompts(2, cfg, seed=7)
     reqs = {eng.submit(prompts[0], 6): (prompts[0], 6, 0)}
     eng.step()

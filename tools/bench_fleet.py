@@ -1,0 +1,241 @@
+"""Fleet-supervision availability bench: excision vs a headless fleet.
+
+The question BENCH_fleet.json answers: when a replica dies mid-traffic
+(seeded ``replica_kill`` at a ``FLEET_STEP``), how much of the offered
+work does a SUPERVISED fleet (lease ladder -> DEAD -> proof-gated
+excision -> displaced streams rebound across survivors) complete within
+a fixed tick budget, vs the same fleet with supervision effectively off
+(an infinite lease: the corpse is never declared, its streams stall
+forever)?
+
+One seeded schedule drives both legs: identical prompts, identical
+dispatch, the identical kill. Availability = finished streams / offered
+streams at the shared tick budget. The supervised leg must finish
+EVERYTHING (displaced streams replay from scratch on survivors,
+token-for-token greedy vs solo decode — the fault-requeue contract);
+the headless leg strands whatever the corpse owned. The acceptance bar
+(ISSUE 17): supervised availability >= 1.5x the no-excision baseline,
+greedy parity on every finished stream in BOTH legs, a valid partial-
+consensus excise proof, and a live ``replica_add`` after the excision
+restoring the fleet to full strength with parity on a fresh batch.
+
+Usage: python tools/bench_fleet.py [--seed N] [--fast] [--json PATH]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+KILL_AT = 3          # FLEET_STEP poll index the kill lands on
+KILL_TARGET = 1      # the member that dies
+MAX_NEW = 16         # tokens per stream
+
+
+def _build(params, cfg, supervised):
+    from gradaccum_tpu.serving import ReplicatedEngine
+
+    # the headless leg keeps the identical engine/dispatch but a lease
+    # that never expires: the kill still halts the member's ticks, yet
+    # no verdict is ever reached and nobody may excise
+    ttl = (5.0, 2.0) if supervised else (1e9, 0.5e9)
+    return ReplicatedEngine(params, cfg, replicas=2, tp=None, num_slots=3,
+                            max_len=48, fleet_lease_ttl=ttl[0],
+                            fleet_suspect_after=ttl[1])
+
+
+def _run_leg(seed, supervised, streams, budget_ticks, log):
+    import numpy as np
+
+    import jax
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.resilience.faults import (
+        FaultInjector,
+        FaultSchedule,
+        FaultSpec,
+    )
+    from gradaccum_tpu.serving import fleet as fleet_lib
+    from gradaccum_tpu.serving import replica_add, replica_excise
+
+    rng = np.random.default_rng(seed)
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(jax.random.PRNGKey(0),
+                         {"input_ids": np.zeros((1, 8), np.int32)})
+    fleet = _build(params, cfg, supervised)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=(int(rng.integers(2, 8)),)).astype(np.int32)
+               for _ in range(streams)]
+    reqs = {fleet.submit(p, MAX_NEW): p for p in prompts}
+
+    plan = FaultSchedule([FaultSpec(faults.FLEET_STEP, at=KILL_AT,
+                                    kind=faults.KIND_REPLICA_KILL,
+                                    target=KILL_TARGET)])
+    finish_tick = {}
+    kill_tick = dead_tick = excise_tick = None
+    moved = {}
+    proof = None
+    t0 = time.monotonic()
+    with faults.installed(FaultInjector(plan)):
+        for tick in range(budget_ticks):
+            ev = fleet.step()
+            for rid, _reason in ev.finished:
+                finish_tick.setdefault(rid, tick)
+            sup = fleet.fleet
+            if kill_tick is None and sup.halted(KILL_TARGET):
+                kill_tick = tick
+            if supervised:
+                if (dead_tick is None
+                        and sup.state(KILL_TARGET) == fleet_lib.DEAD):
+                    dead_tick = tick
+                if dead_tick is not None and excise_tick is None:
+                    res = fleet.reconfigure(replica_excise(KILL_TARGET))
+                    if res.ok:
+                        excise_tick = tick
+                        proof = res.detail["excise_proof"]
+                        moved = dict(res.detail["resubmitted"])
+            if len(finish_tick) == streams:
+                break
+    wall = time.monotonic() - t0
+
+    parity = True
+    finished = 0
+    for rid, p in reqs.items():
+        rid = moved.get(rid, rid)
+        if rid not in finish_tick:  # stranded on the corpse: not finished
+            continue
+        finished += 1
+        toks, status = fleet.pop_result(rid)
+        want = np.asarray(generate_cached(params, cfg, p, MAX_NEW))
+        if status != "done" or not np.array_equal(
+                np.asarray(toks), want[0, p.size:]):
+            parity = False
+
+    leg = {
+        "streams": streams,
+        "finished": finished,
+        "availability": round(finished / streams, 4),
+        "budget_ticks": budget_ticks,
+        "kill_tick": kill_tick,
+        "dead_tick": dead_tick,
+        "excise_tick": excise_tick,
+        "mttr_ticks": (excise_tick - kill_tick
+                       if excise_tick is not None and kill_tick is not None
+                       else None),
+        "excise_proof": proof,
+        "displaced_resubmitted": len(moved),
+        "parity": parity,
+        "wall_s": round(wall, 2),
+    }
+
+    restored = None
+    if supervised and excise_tick is not None:
+        # live ADD after the excision: full strength restored, fresh
+        # traffic serves token-for-token over the widened id lattice
+        res = fleet.reconfigure(replica_add())
+        ok = bool(res.ok)
+        add_parity = False
+        if ok:
+            fresh = [rng.integers(0, cfg.vocab_size,
+                                  size=(4,)).astype(np.int32)
+                     for _ in range(4)]
+            fresh_reqs = {fleet.submit(p, 8): p for p in fresh}
+            fleet.run_until_idle()
+            add_parity = True
+            for rid, p in fresh_reqs.items():
+                toks, status = fleet.pop_result(rid)
+                want = np.asarray(generate_cached(params, cfg, p, 8))
+                if status != "done" or not np.array_equal(
+                        np.asarray(toks), want[0, p.size:]):
+                    add_parity = False
+        restored = {
+            "ok": ok,
+            "active_replicas": len(fleet.active_replicas),
+            "parity": add_parity,
+        }
+        leg["add_after_excise"] = restored
+
+    name = "supervised" if supervised else "no-excision"
+    log(f"[fleet/{name}] {finished}/{streams} finished "
+        f"(availability {leg['availability']}), kill@{kill_tick} "
+        f"dead@{dead_tick} excise@{excise_tick}, parity={parity}, "
+        f"wall {wall:.1f}s")
+    fleet.close()
+    return leg
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=0xF1EE7)
+    ap.add_argument("--fast", action="store_true",
+                    help="6 streams instead of 9 (CI smoke)")
+    ap.add_argument("--budget-ticks", type=int, default=200,
+                    help="shared tick budget both legs are measured at")
+    ap.add_argument("--json", default=None,
+                    help="artifact path (default: <repo>/BENCH_fleet.json)")
+    args = ap.parse_args(argv)
+    log = print
+    streams = 6 if args.fast else 9
+
+    log(f"[fleet] seed {args.seed}: {streams} streams, replica_kill "
+        f"target={KILL_TARGET} at FLEET_STEP {KILL_AT}, budget "
+        f"{args.budget_ticks} ticks")
+    sup_leg = _run_leg(args.seed, True, streams, args.budget_ticks, log)
+    base_leg = _run_leg(args.seed, False, streams, args.budget_ticks, log)
+
+    ratio = None
+    if base_leg["availability"]:
+        ratio = round(sup_leg["availability"] / base_leg["availability"], 2)
+    proof = sup_leg.get("excise_proof") or {}
+    restored = sup_leg.get("add_after_excise") or {}
+    required = ("supervised availability (finished/offered streams at the "
+                "shared tick budget) >= 1.5x the no-excision baseline over "
+                "the ONE seeded replica_kill schedule, supervised leg "
+                "finishes EVERY stream with greedy token parity "
+                "(displaced streams replayed on survivors), the excision "
+                "proof valid and partial with the corpse absent, and "
+                "replica_add after the excision restoring full strength "
+                "with parity on a fresh batch")
+    passed = bool(
+        ratio is not None and ratio >= 1.5
+        and sup_leg["finished"] == streams
+        and sup_leg["parity"] and base_leg["parity"]
+        and sup_leg["mttr_ticks"] is not None
+        and proof.get("valid")
+        and restored.get("ok") and restored.get("parity")
+        and restored.get("active_replicas") == 2
+    )
+    artifact = {
+        "bench": "fleet availability through a seeded replica kill: "
+                 "lease->DEAD->excise->rebind vs no supervision (CPU)",
+        "seed": args.seed,
+        "config": {"streams": streams, "replicas": 2,
+                   "kill": {"at": KILL_AT, "target": KILL_TARGET},
+                   "budget_ticks": args.budget_ticks,
+                   "max_new_tokens": MAX_NEW},
+        "supervised": sup_leg,
+        "no_excision": base_leg,
+        "availability_ratio": ratio,
+        "acceptance": {"required": required, "passed": passed},
+    }
+    out = args.json or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_fleet.json",
+    )
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1, default=str)
+        f.write("\n")
+    log(f"[fleet] {'PASS' if passed else 'FAIL'}: availability ratio "
+        f"{ratio} (supervised {sup_leg['availability']} vs no-excision "
+        f"{base_leg['availability']}); wrote {out}")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -153,6 +153,26 @@ def _mttr_column(data) -> str:
     return out
 
 
+def _fleet_column(data) -> str:
+    """Render BENCH_fleet.json's availability comparison: supervised
+    (lease -> DEAD -> excise -> rebind) vs the no-excision baseline at
+    the shared tick budget, plus the kill-to-excise MTTR."""
+    sup = data.get("supervised")
+    base = data.get("no_excision")
+    if not isinstance(sup, dict) or not isinstance(base, dict):
+        return ""
+    try:
+        out = (f"availability supervised {float(sup['availability']):g} vs "
+               f"no-excision {float(base['availability']):g} "
+               f"({float(data['availability_ratio']):.2f}x)")
+    except (KeyError, TypeError, ValueError):
+        return ""
+    mttr = sup.get("mttr_ticks")
+    if isinstance(mttr, (int, float)):
+        out += f", kill→excise {mttr:g} ticks"
+    return out
+
+
 def _memory_column(data) -> str:
     """Render a mixed-precision ``rows`` ladder (BENCH_mixed.json) as the
     per-replica optimizer+accumulator bytes/param progression."""
@@ -243,6 +263,7 @@ def collect(bench_dir: str):
             "cow": _cow_column(data) or None,
             "reconfig": _reconfig_column(data) or None,
             "mttr": _mttr_column(data) or None,
+            "fleet": _fleet_column(data) or None,
             "acceptance": acceptance,
             "passed": None if acceptance is None
             else bool(acceptance.get("passed")),
@@ -323,6 +344,8 @@ def main(argv=None) -> int:
                 detail += f" — {r['reconfig']}"
             if r.get("mttr"):
                 detail += f" — {r['mttr']}"
+            if r.get("fleet"):
+                detail += f" — {r['fleet']}"
             if required != "":
                 detail += f" [{required}]"
             if not r["passed"]:

@@ -85,6 +85,10 @@ def draw_plan(seed: int) -> dict:
         # degradation arms, and the unhealable leg's starting pool
         "healer_degrade_tick": int(rng.integers(8, 14)),
         "healer_pool_blocks": int(rng.integers(18, 25)),
+        # fleet phase (appended last, same discipline): which member the
+        # seeded replica_kill lands on, and at which FLEET_STEP poll
+        "fleet_kill_target": int(rng.integers(0, 2)),
+        "fleet_kill_poll": int(rng.integers(2, 6)),
     }
 
 
@@ -883,6 +887,102 @@ def _healer_chaos(seed: int, log, plan):
     return detail
 
 
+def _fleet_chaos(seed: int, log, plan):
+    """The supervised-fleet phase: a seeded ``replica_kill`` at a
+    ``FLEET_STEP`` poll must resolve through the membership ladder
+    (halted -> lease stale -> DEAD), the excision must be proof-gated
+    (partial consensus WITHOUT the corpse's vote), every displaced
+    stream must finish token-for-token on a survivor, and a live
+    ``replica_add`` afterwards must restore full strength and serve a
+    fresh batch with parity over the widened id lattice."""
+    import jax
+    import numpy as np
+
+    from gradaccum_tpu.models.gpt import GPTConfig, gpt_lm_bundle
+    from gradaccum_tpu.models.gpt_decode import generate_cached
+    from gradaccum_tpu.resilience import faults
+    from gradaccum_tpu.resilience.faults import (
+        FaultInjector,
+        FaultSchedule,
+        FaultSpec,
+    )
+    from gradaccum_tpu.serving import (
+        ReplicatedEngine,
+        replica_add,
+        replica_excise,
+    )
+    from gradaccum_tpu.serving import fleet as fleet_lib
+
+    rng = np.random.default_rng(seed + 13)
+    cfg = GPTConfig.tiny_for_tests(dropout=0.0)
+    bundle = gpt_lm_bundle(cfg)
+    params = bundle.init(jax.random.PRNGKey(0),
+                         {"input_ids": np.zeros((1, 8), np.int32)})
+    target = plan["fleet_kill_target"]
+    kill_poll = plan["fleet_kill_poll"]
+    fleet = ReplicatedEngine(params, cfg, replicas=2, tp=None, num_slots=3,
+                             max_len=48, fleet_lease_ttl=5.0,
+                             fleet_suspect_after=2.0)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=(int(rng.integers(2, 8)),)).astype(np.int32)
+               for _ in range(6)]
+    reqs = {fleet.submit(p, 12): p for p in prompts}
+    log(f"[chaos/fleet] plan: replica_kill target={target} at "
+        f"FLEET_STEP {kill_poll}")
+
+    injector = FaultInjector(FaultSchedule([
+        FaultSpec(faults.FLEET_STEP, at=kill_poll,
+                  kind=faults.KIND_REPLICA_KILL, target=target),
+    ]))
+    with faults.installed(injector):
+        for _ in range(80):
+            fleet.step()
+            if fleet.fleet.state(target) == fleet_lib.DEAD:
+                break
+    assert injector.fired, "the seeded replica_kill never fired"
+    assert fleet.fleet.state(target) == fleet_lib.DEAD, \
+        f"kill never resolved DEAD: {fleet.fleet.states()}"
+    dead_t = next(t for t in fleet.fleet.log if t.new == fleet_lib.DEAD)
+
+    res = fleet.reconfigure(replica_excise(target))
+    assert res.ok, f"excision refused: {res.reason}"
+    proof = res.detail["excise_proof"]
+    assert proof["valid"] and target in proof["absent"] \
+        and target not in proof["voters"], proof
+    moved = dict(res.detail["resubmitted"])
+    fleet.run_until_idle()
+    for rid, p in reqs.items():
+        toks, status = fleet.pop_result(moved.get(rid, rid))
+        assert status == "done", (rid, status)
+        want = np.asarray(generate_cached(params, cfg, p, 12))
+        np.testing.assert_array_equal(np.asarray(toks), want[0, p.size:])
+    assert fleet.replicas[target].idle, "work landed on the corpse"
+
+    add = fleet.reconfigure(replica_add())
+    assert add.ok, f"replica_add refused: {add.reason}"
+    assert len(fleet.active_replicas) == 2, fleet.active_replicas
+    fresh = {fleet.submit(p, 8): p
+             for p in [rng.integers(0, cfg.vocab_size, 4).astype(np.int32)
+                       for _ in range(4)]}
+    fleet.run_until_idle()
+    for rid, p in fresh.items():
+        toks, status = fleet.pop_result(rid)
+        assert status == "done", (rid, status)
+        want = np.asarray(generate_cached(params, cfg, p, 8))
+        np.testing.assert_array_equal(np.asarray(toks), want[0, p.size:])
+    fleet.close()
+    log(f"[chaos/fleet] PASS: kill@{kill_poll} -> DEAD "
+        f"({dead_t.reason}) -> proof-gated excise "
+        f"({len(moved)} stream(s) rebound) -> replica_add restored "
+        f"{len(fleet.active_replicas)} active, parity clean")
+    return {"kill": {"target": target, "poll": kill_poll},
+            "dead_reason": dead_t.reason,
+            "excise_proof": proof,
+            "displaced_resubmitted": len(moved),
+            "added_replica": add.detail["replica"],
+            "requests": len(reqs) + len(fresh)}
+
+
 def run_one(seed: int, log) -> dict:
     """Every chaos phase under ONE seeded plan; returns the detail dict
     (raises AssertionError on any gate failure)."""
@@ -906,6 +1006,7 @@ def run_one(seed: int, log) -> dict:
         detail["reconfig"] = _reconfig_chaos(seed, log, plan)
         detail["ops"] = _ops_chaos(seed, log)
         detail["healer"] = _healer_chaos(seed, log, plan)
+        detail["fleet"] = _fleet_chaos(seed, log, plan)
     return detail
 
 
@@ -945,7 +1046,13 @@ def main(argv=None) -> int:
                 "unhealable one escalates through a healer-tagged "
                 "pool-grow reconfig (initiator=healer) and freezes "
                 "TERMINALLY (healer_frozen, severity page, zero actions "
-                "after the freeze)")
+                "after the freeze); fleet phase: a seeded replica_kill "
+                "at a FLEET_STEP resolves DEAD through the membership "
+                "lease ladder, the excision is proof-gated (partial "
+                "consensus without the corpse's vote), displaced streams "
+                "finish token-for-token on survivors, and a live "
+                "replica_add restores full strength with parity over the "
+                "widened id lattice")
     passed = True
     detail = {}
     seeds = list(range(args.seed, args.seed + max(1, args.seed_range)))
